@@ -1,0 +1,20 @@
+"""scdna_replication_tools_tpu — TPU-native PERT framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of
+shahcompbio/scdna_replication_tools (PERT: probabilistic estimation of
+replication timing from scWGS data).  The probabilistic core is a pure-JAX
+MAP/enumeration objective compiled with XLA and sharded over a TPU mesh
+(cells axis data-parallel); the pandas-in/pandas-out API contract of the
+reference (`infer_scRT.scRT`) is preserved.
+
+Public API mirrors the reference package surface (reference:
+scdna_replication_tools/infer_scRT.py:25, infer_SPF.py:18,
+pert_simulator.py:285, predict_cycle_phase.py:99, ...).
+"""
+
+__version__ = "0.1.0"
+
+from scdna_replication_tools_tpu.api import scRT, SPF
+from scdna_replication_tools_tpu.config import PertConfig
+
+__all__ = ["scRT", "SPF", "PertConfig", "__version__"]

@@ -1,0 +1,294 @@
+"""Public pandas-in / pandas-out facade: ``scRT`` and ``SPF``.
+
+API parity with the reference classes (reference: infer_scRT.py:25-291,
+infer_SPF.py:18-111): same constructor keywords, same ``infer(level=...)``
+dispatch, same four-DataFrame return.  The probabilistic 'pert' level runs
+on the TPU-native JAX engine (see ``infer.runner``); a ``backend`` flag is
+accepted for forward compatibility ('jax' is the only backend).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+from scdna_replication_tools_tpu.config import ColumnConfig, PertConfig
+from scdna_replication_tools_tpu.data.loader import build_pert_inputs
+from scdna_replication_tools_tpu.infer.runner import (
+    PertInference,
+    package_step_output,
+)
+from scdna_replication_tools_tpu.models.pert import constrained
+from scdna_replication_tools_tpu.pipeline.assign import assign_s_to_clones
+from scdna_replication_tools_tpu.pipeline.clustering import kmeans_cluster
+from scdna_replication_tools_tpu.pipeline.consensus import (
+    compute_consensus_clone_profiles,
+)
+
+
+class scRT:
+    """Single-cell replication-timing inference facade.
+
+    Mirrors ``infer_scRT.scRT`` (reference: infer_scRT.py:25-105) with the
+    same keyword surface; TPU-execution extras: ``backend``, ``num_shards``,
+    ``cell_chunk``, ``checkpoint_dir``.
+    """
+
+    def __init__(self, cn_s, cn_g1, input_col='reads', assign_col='copy',
+                 library_col='library_id', ploidy_col='ploidy',
+                 cell_col='cell_id', cn_state_col='state', chr_col='chr',
+                 start_col='start', gc_col='gc', rv_col='rt_value',
+                 rs_col='rt_state', frac_rt_col='frac_rt',
+                 clone_col='clone_id', rt_prior_col='mcf7rt',
+                 cn_prior_method='g1_composite', col2='rpm_gc_norm',
+                 col3='temp_rt', col4='changepoint_segments',
+                 col5='binary_thresh', max_iter=2000, min_iter=100,
+                 max_iter_step1=None, min_iter_step1=None,
+                 max_iter_step3=None, min_iter_step3=None,
+                 cn_prior_weight=1e6, learning_rate=0.05, rel_tol=1e-6,
+                 cuda=False, seed=0, P=13, K=4, J=5, upsilon=6,
+                 run_step3=True, backend='jax', num_shards=1,
+                 cell_chunk=None, checkpoint_dir=None):
+        self.cn_s = cn_s
+        self.cn_g1 = cn_g1
+        self.clone_col = clone_col
+        self.backend = backend
+
+        self.cols = ColumnConfig(
+            input_col=input_col, gc_col=gc_col, rt_prior_col=rt_prior_col,
+            clone_col=clone_col, cell_col=cell_col, library_col=library_col,
+            chr_col=chr_col, start_col=start_col, cn_state_col=cn_state_col,
+            assign_col=assign_col, ploidy_col=ploidy_col, rv_col=rv_col,
+            rs_col=rs_col, frac_rt_col=frac_rt_col, rpm_gc_norm_col=col2,
+            temp_rt_col=col3, seg_col=col4, thresh_col=col5,
+        )
+        self.config = PertConfig(
+            P=P, K=K, J=J, upsilon=upsilon,
+            cn_prior_method=cn_prior_method, cn_prior_weight=cn_prior_weight,
+            learning_rate=learning_rate, max_iter=max_iter, min_iter=min_iter,
+            rel_tol=rel_tol, max_iter_step1=max_iter_step1,
+            min_iter_step1=min_iter_step1, max_iter_step3=max_iter_step3,
+            min_iter_step3=min_iter_step3, run_step3=run_step3, seed=seed,
+            num_shards=num_shards, cell_chunk=cell_chunk,
+            checkpoint_dir=checkpoint_dir,
+        )
+
+        self.clone_profiles = None
+        self.bulk_cn = None
+        self.manhattan_df = None
+
+    # -- dispatch (reference: infer_scRT.py:108-124) ----------------------
+
+    def infer(self, level: str = 'pert'):
+        supp_s_out_df = pd.DataFrame({})
+        supp_g1_out_df = pd.DataFrame({})
+        cn_g1_out = pd.DataFrame({})
+        if level == 'cell':
+            self.cn_s = self.infer_cell_level()
+        elif level == 'clone':
+            self.cn_s = self.infer_clone_level()
+        elif level == 'bulk':
+            self.cn_s = self.infer_bulk_level()
+        elif level in ('pyro', 'pert', 'jax'):
+            self.cn_s, supp_s_out_df, cn_g1_out, supp_g1_out_df = \
+                self.infer_pert_model()
+        return self.cn_s, supp_s_out_df, cn_g1_out, supp_g1_out_df
+
+    # -- clustering + clone assignment ------------------------------------
+
+    def _ensure_clones(self, assign_col: str):
+        """Cluster G1 cells if no clone column, then assign S cells.
+
+        Mirrors infer_pert_model's preamble (reference: infer_scRT.py:129-148).
+        """
+        c = self.cols
+        if self.clone_col is None:
+            g1_mat = self.cn_g1.pivot_table(
+                columns=c.cell_col, index=[c.chr_col, c.start_col],
+                values=c.assign_col, observed=True)
+            clusters = kmeans_cluster(g1_mat, max_k=20)
+            self.cn_g1 = pd.merge(self.cn_g1, clusters, on=c.cell_col)
+            self.clone_col = 'cluster_id'
+
+        self.clone_profiles = compute_consensus_clone_profiles(
+            self.cn_g1, assign_col, clone_col=self.clone_col,
+            cell_col=c.cell_col, chr_col=c.chr_col, start_col=c.start_col,
+            cn_state_col=c.cn_state_col)
+
+        self.cn_s = assign_s_to_clones(
+            self.cn_s, self.clone_profiles, col_name=assign_col,
+            clone_col=self.clone_col, cell_col=c.cell_col,
+            chr_col=c.chr_col, start_col=c.start_col)
+
+    # -- PERT (reference: infer_scRT.py:127-168) --------------------------
+
+    def infer_pert_model(self):
+        c = self.cols
+        self._ensure_clones(c.assign_col)
+
+        cols = (self.cols if self.clone_col == c.clone_col else
+                ColumnConfig(**{**self.cols.__dict__, 'clone_col': self.clone_col}))
+        s_data, g1_data = build_pert_inputs(self.cn_s, self.cn_g1, cols)
+
+        # dense clone indices aligned to the data cell order
+        clone_ids = sorted(self.cn_g1[self.clone_col].astype(str).unique())
+        clone_map = {cid: i for i, cid in enumerate(clone_ids)}
+
+        def _clone_idx(cn, cell_ids):
+            per_cell = cn[[c.cell_col, self.clone_col]] \
+                .drop_duplicates(c.cell_col).set_index(c.cell_col)[self.clone_col]
+            return np.array([clone_map[str(per_cell[cid])]
+                             for cid in cell_ids], np.int32)
+
+        inference = PertInference(
+            s_data, g1_data, self.config,
+            clone_idx_s=_clone_idx(self.cn_s, s_data.cell_ids),
+            clone_idx_g1=_clone_idx(self.cn_g1, g1_data.cell_ids),
+            num_clones=len(clone_ids),
+        )
+        step1, step2, step3 = inference.run()
+
+        lamb = float(np.asarray(
+            constrained(step1.spec, step1.fit.params, step1.fixed)["lamb"]
+        ).reshape(-1)[0])
+
+        cn_s_out, supp_s_out = package_step_output(
+            self.cn_s, inference._step2_data, step2, lamb,
+            step1.fit.losses, step2.fit.losses, cols)
+
+        if step3 is not None:
+            cn_g1_out, supp_g1_out = package_step_output(
+                self.cn_g1, inference._step3_data, step3, lamb,
+                step1.fit.losses, step3.fit.losses, cols)
+        else:
+            cn_g1_out, supp_g1_out = None, None
+
+        return cn_s_out, supp_s_out, cn_g1_out, supp_g1_out
+
+    # -- deterministic levels (implemented in pipeline/, wired in api) ----
+
+    def infer_cell_level(self):
+        from scdna_replication_tools_tpu.pipeline.deterministic import (
+            infer_cell_level,
+        )
+        cn_s, self.manhattan_df, self.clone_profiles, clone_col = \
+            infer_cell_level(self.cn_s, self.cn_g1, self.cols, self.clone_col)
+        self.clone_col = clone_col
+        return cn_s
+
+    def infer_clone_level(self):
+        from scdna_replication_tools_tpu.pipeline.deterministic import (
+            infer_clone_level,
+        )
+        cn_s, self.manhattan_df, self.clone_profiles, clone_col = \
+            infer_clone_level(self.cn_s, self.cn_g1, self.cols, self.clone_col)
+        self.clone_col = clone_col
+        return cn_s
+
+    def infer_bulk_level(self):
+        from scdna_replication_tools_tpu.pipeline.deterministic import (
+            infer_bulk_level,
+        )
+        cn_s, self.manhattan_df = infer_bulk_level(
+            self.cn_s, self.cn_g1, self.cols, self.clone_col)
+        return cn_s
+
+    # -- downstream (reference: infer_scRT.py:279-290) --------------------
+
+    def compute_pseudobulk_rt_profiles(self, output_col='pseudobulk',
+                                       time_col='hours'):
+        from scdna_replication_tools_tpu.pipeline.pseudobulk import (
+            compute_pseudobulk_rt_profiles,
+        )
+        self.bulk_cn = compute_pseudobulk_rt_profiles(
+            self.cn_s, self.cols.rv_col, output_col=output_col,
+            time_col=time_col, clone_col=self.clone_col,
+            chr_col=self.cols.chr_col, start_col=self.cols.start_col)
+        return self.bulk_cn
+
+    def calculate_twidth(self, pseudobulk_col='pseudobulk_hours',
+                         tfs_col='time_from_scheduled_rt', per_cell=False,
+                         query2=None, curve='sigmoid'):
+        from scdna_replication_tools_tpu.pipeline.twidth import (
+            calculate_twidth,
+            compute_time_from_scheduled_column,
+        )
+        cn = pd.merge(self.cn_s, self.bulk_cn)
+        cn = compute_time_from_scheduled_column(
+            cn, pseudobulk_col=pseudobulk_col,
+            frac_rt_col=self.cols.frac_rt_col, tfs_col=tfs_col)
+        return calculate_twidth(cn, tfs_col=tfs_col, rs_col=self.cols.rs_col,
+                                cell_col=self.cols.cell_col,
+                                per_cell=per_cell, query2=query2, curve=curve)
+
+
+class SPF:
+    """Per-clone S-phase fraction with bootstrap errors.
+
+    Mirrors ``infer_SPF.SPF`` (reference: infer_SPF.py:18-111).
+    """
+
+    def __init__(self, cn_s, cn_g1, input_col='reads', clone_col='clone_id',
+                 seed: int = 0):
+        self.cn_s = cn_s
+        self.cn_g1 = cn_g1
+        self.input_col = input_col
+        self.clone_col = clone_col
+        self.rng = np.random.default_rng(seed)
+
+    def infer(self):
+        if self.clone_col is None:
+            g1_mat = self.cn_g1.pivot_table(
+                columns='cell_id', index=['chr', 'start'],
+                values=self.input_col, observed=True)
+            clusters = kmeans_cluster(g1_mat)
+            self.cn_g1 = pd.merge(self.cn_g1, clusters, on='cell_id')
+            self.clone_col = 'cluster_id'
+
+        self.clone_profiles = compute_consensus_clone_profiles(
+            self.cn_g1, self.input_col, clone_col=self.clone_col)
+        self.cn_s = assign_s_to_clones(self.cn_s, self.clone_profiles,
+                                       col_name=self.input_col,
+                                       clone_col=self.clone_col)
+        self.output_df = self.calculate_clone_fractions()
+        return self.cn_s, self.output_df
+
+    def calculate_clone_fractions(self, N_subsamples=500,
+                                  frac_subsample=0.75) -> pd.DataFrame:
+        """Bootstrap SPF per clone (reference: infer_SPF.py:49-111),
+        vectorised: all subsample counts come from binomial draws over the
+        cell->clone table instead of 500 pandas sample() loops."""
+        s_df = self.cn_s[['cell_id', self.clone_col]].drop_duplicates()
+        g_df = self.cn_g1[['cell_id', self.clone_col]].drop_duplicates()
+
+        s_counts = s_df[self.clone_col].value_counts().sort_index()
+        g_counts = g_df[self.clone_col].value_counts().sort_index()
+        clones = sorted(set(s_counts.index) | set(g_counts.index))
+        s_n = np.array([s_counts.get(c, 0) for c in clones], np.int64)
+        g_n = np.array([g_counts.get(c, 0) for c in clones], np.int64)
+
+        spf = s_n / np.maximum(s_n + g_n, 1)
+
+        # bootstrap: subsampling 75% of all cells uniformly without
+        # replacement makes the per-(clone, phase) counts jointly
+        # multivariate-hypergeometric, so the 500 pandas ``sample`` loops
+        # of the reference collapse into one vectorised draw
+        category_counts = np.concatenate([s_n, g_n])   # (2 * clones,)
+        n_total = int(category_counts.sum())
+        k = int(round(frac_subsample * n_total))
+        draws = self.rng.multivariate_hypergeometric(
+            category_counts, k, size=N_subsamples)     # (N, 2 * clones)
+        s_draw = draws[:, :len(clones)].astype(np.float64)
+        g_draw = draws[:, len(clones):].astype(np.float64)
+        fracs = s_draw / np.maximum(s_draw + g_draw, 1.0)
+        spf_std = fracs.std(axis=0, ddof=1)
+
+        return pd.DataFrame({
+            'clone_id': clones,
+            'SPF': spf,
+            'SPF_std': spf_std,
+            'num_s': s_n,
+            'num_g': g_n,
+        })

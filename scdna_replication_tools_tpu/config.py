@@ -1,0 +1,99 @@
+"""Typed configuration for the PERT model and inference driver.
+
+The reference spreads ~30 keyword arguments across ``scRT.__init__``
+(reference: infer_scRT.py:26-105) and ``pert_infer_scRT.__init__``
+(reference: pert_model.py:37-130).  Here the same knobs are centralised in
+two frozen dataclasses: :class:`ColumnConfig` (column-name indirection for
+the long-form pandas contract) and :class:`PertConfig` (model
+hyper-parameters + optimisation budget + TPU execution knobs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnConfig:
+    """Column-name mapping for long-form scWGS DataFrames.
+
+    Mirrors the ``*_col`` kwargs of the reference facade
+    (reference: infer_scRT.py:26-31).
+    """
+
+    input_col: str = "reads"
+    gc_col: str = "gc"
+    rt_prior_col: Optional[str] = "mcf7rt"
+    clone_col: Optional[str] = "clone_id"
+    cell_col: str = "cell_id"
+    library_col: str = "library_id"
+    chr_col: str = "chr"
+    start_col: str = "start"
+    cn_state_col: str = "state"
+    assign_col: str = "copy"
+    ploidy_col: str = "ploidy"
+    # replication-timing output columns
+    rv_col: str = "rt_value"
+    rs_col: str = "rt_state"
+    frac_rt_col: str = "frac_rt"
+    # intermediate columns used by the deterministic pipeline
+    # (reference: infer_scRT.py:29 col2..col5)
+    rpm_gc_norm_col: str = "rpm_gc_norm"
+    temp_rt_col: str = "temp_rt"
+    seg_col: str = "changepoint_segments"
+    thresh_col: str = "binary_thresh"
+
+
+@dataclasses.dataclass(frozen=True)
+class PertConfig:
+    """Hyper-parameters of the PERT graphical model + SVI driver.
+
+    Field semantics follow the reference constructor
+    (reference: pert_model.py:37-130); TPU-execution fields are new.
+    """
+
+    # --- model size constants (reference: pert_model.py:124-129) ---
+    P: int = 13          # number of integer CN states, values 0..P-1
+    K: int = 4           # max polynomial degree of the GC bias curve
+    J: int = 5           # G1 cells per S cell in the composite CN prior
+    upsilon: int = 6     # alpha+beta total for the tau Beta prior
+
+    # --- priors / conditioning ---
+    cn_prior_method: str = "g1_composite"
+    cn_prior_weight: float = 1e6
+
+    # --- optimisation (reference: pert_model.py:41, 104-120, 734) ---
+    learning_rate: float = 0.05
+    adam_b1: float = 0.8
+    adam_b2: float = 0.99
+    max_iter: int = 2000
+    min_iter: int = 100
+    rel_tol: float = 1e-6
+    max_iter_step1: Optional[int] = None   # default: max_iter // 2
+    min_iter_step1: Optional[int] = None   # default: min_iter // 2
+    max_iter_step3: Optional[int] = None
+    min_iter_step3: Optional[int] = None
+    run_step3: bool = True
+    seed: int = 0
+
+    # --- TPU execution knobs (new; no reference counterpart) ---
+    # number of cells processed per lax.scan chunk inside the loss; None
+    # materialises the full (cells, loci, P, 2) enumeration tensor at once.
+    cell_chunk: Optional[int] = None
+    # shard the cells axis over this many devices; 1 = single device,
+    # None or 0 = use every local device.
+    num_shards: Optional[int] = 1
+    # write checkpoints at step boundaries (step1/step2/step3) to this dir.
+    checkpoint_dir: Optional[str] = None
+
+    def resolved_iters(self) -> dict:
+        """Step 1/3 budgets default to half of step 2's (pert_model.py:104-120)."""
+        return dict(
+            max_iter=self.max_iter,
+            min_iter=self.min_iter,
+            max_iter_step1=self.max_iter_step1 if self.max_iter_step1 is not None else self.max_iter // 2,
+            min_iter_step1=self.min_iter_step1 if self.min_iter_step1 is not None else self.min_iter // 2,
+            max_iter_step3=self.max_iter_step3 if self.max_iter_step3 is not None else self.max_iter // 2,
+            min_iter_step3=self.min_iter_step3 if self.min_iter_step3 is not None else self.min_iter // 2,
+        )

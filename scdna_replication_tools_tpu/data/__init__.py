@@ -1,0 +1,8 @@
+from scdna_replication_tools_tpu.data.loader import (
+    PertData,
+    build_pert_inputs,
+    pad_cells,
+    pivot_matrix,
+)
+
+__all__ = ["PertData", "build_pert_inputs", "pad_cells", "pivot_matrix"]

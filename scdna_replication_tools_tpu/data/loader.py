@@ -1,0 +1,222 @@
+"""Host-side data pipeline: long-form pandas ⇄ dense (cells, loci) arrays.
+
+TPU-native replacement for ``pert_infer_scRT.process_input_data``
+(reference: pert_model.py:133-191).  Differences by design:
+
+* arrays are laid out **(cells, loci)** — cells is the batch/shard axis for
+  the TPU mesh, loci the contiguous vector axis (the reference uses
+  (loci, cells) to match Pyro plate dims);
+* static-shape friendly: :func:`pad_cells` pads the cells axis to a multiple
+  of the shard count and returns a boolean mask that the compiled loss
+  threads through every per-cell term (XLA requires static shapes; the
+  reference instead relies on ``dropna``);
+* the loci set is the intersection of fully-observed loci across the S and
+  G1 pivots (the reference drops NaN columns independently then asserts the
+  shapes agree, pert_model.py:148-154).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+from scdna_replication_tools_tpu.config import ColumnConfig
+from scdna_replication_tools_tpu.utils.chrom import as_chr_categorical
+
+
+@dataclasses.dataclass
+class PertData:
+    """Dense per-phase model inputs plus the metadata to map back to pandas.
+
+    ``reads``/``states`` are (num_cells, num_loci) float32; ``libs`` is
+    (num_cells,) int32 of library indices; ``gammas`` (num_loci,) float32 GC
+    content; ``rt_prior`` optional (num_loci,) float32 scaled to [0, 1]
+    (reference: pert_model.py:254-257); ``cell_mask`` marks real (non-pad)
+    cells.
+    """
+
+    reads: np.ndarray
+    states: Optional[np.ndarray]
+    libs: np.ndarray
+    gammas: np.ndarray
+    rt_prior: Optional[np.ndarray]
+    cell_ids: List
+    loci: pd.MultiIndex          # MultiIndex of (chr, start)
+    library_ids: List            # index -> library id string
+    cell_mask: np.ndarray        # (num_cells,) bool
+
+    @property
+    def num_cells(self) -> int:
+        return self.reads.shape[0]
+
+    @property
+    def num_loci(self) -> int:
+        return self.reads.shape[1]
+
+    @property
+    def num_libraries(self) -> int:
+        return len(self.library_ids)
+
+
+def pivot_matrix(
+    cn: pd.DataFrame,
+    value_col: str,
+    cols: ColumnConfig = ColumnConfig(),
+) -> pd.DataFrame:
+    """Pivot a long-form frame to a (cell × locus) matrix in genome order.
+
+    Equivalent to the reference's ``pivot_table(index=cell, columns=[chr,
+    start])`` calls (reference: pert_model.py:143-146) but keeps cells as
+    rows (our batch axis).
+    """
+    cn = cn[cn[value_col].notna()].copy()
+    cn[cols.chr_col] = as_chr_categorical(cn[cols.chr_col])
+    mat = cn.pivot_table(
+        index=cols.cell_col,
+        columns=[cols.chr_col, cols.start_col],
+        values=value_col,
+        observed=True,
+    )
+    # pivot_table sorts the categorical chr level; enforce genomic order
+    mat = mat.sort_index(axis=1)
+    return mat
+
+
+def _library_index(
+    cn_s: pd.DataFrame, cn_g1: pd.DataFrame, cols: ColumnConfig
+) -> Tuple[pd.Series, pd.Series, List]:
+    """Map library ids to dense integers shared across both phases.
+
+    Mirrors ``get_libraries_tensor`` (reference: pert_model.py:206-225).
+    """
+    libs_s = cn_s[[cols.cell_col, cols.library_col]].drop_duplicates(cols.cell_col)
+    libs_g1 = cn_g1[[cols.cell_col, cols.library_col]].drop_duplicates(cols.cell_col)
+    all_ids = list(pd.concat([libs_s, libs_g1])[cols.library_col].unique())
+    mapping = {lib: i for i, lib in enumerate(all_ids)}
+    s = libs_s.set_index(cols.cell_col)[cols.library_col].map(mapping)
+    g1 = libs_g1.set_index(cols.cell_col)[cols.library_col].map(mapping)
+    return s, g1, all_ids
+
+
+def _per_locus_profile(
+    cn: pd.DataFrame, value_col: str, loci: pd.MultiIndex, cols: ColumnConfig
+) -> Optional[np.ndarray]:
+    """Extract one value per locus (GC content / RT prior), aligned to ``loci``."""
+    if value_col is None or value_col not in cn.columns:
+        return None
+    prof = (
+        cn[[cols.chr_col, cols.start_col, value_col]]
+        .drop_duplicates([cols.chr_col, cols.start_col])
+        .dropna()
+    )
+    prof[cols.chr_col] = prof[cols.chr_col].astype(str)
+    prof = prof.set_index([cols.chr_col, cols.start_col])[value_col]
+    # align to the loci index (chr level of `loci` is categorical; compare as str)
+    key = pd.MultiIndex.from_arrays(
+        [loci.get_level_values(0).astype(str), loci.get_level_values(1)]
+    )
+    aligned = prof.reindex(key)
+    if aligned.isna().any():
+        missing = int(aligned.isna().sum())
+        raise ValueError(
+            f"column {value_col!r} is missing for {missing} loci shared by the "
+            "read-count pivots"
+        )
+    return aligned.to_numpy(dtype=np.float32)
+
+
+def build_pert_inputs(
+    cn_s: pd.DataFrame,
+    cn_g1: pd.DataFrame,
+    cols: ColumnConfig = ColumnConfig(),
+) -> Tuple[PertData, PertData]:
+    """Build dense model inputs for the S and G1/2 populations.
+
+    Replaces ``process_input_data`` (reference: pert_model.py:133-191):
+    genome-ordered sort, NaN-row drop, pivot to dense matrices, shared
+    library index, per-locus GC and optional RT-prior profiles.
+    """
+    s_reads = pivot_matrix(cn_s, cols.input_col, cols)
+    g1_reads = pivot_matrix(cn_g1, cols.input_col, cols)
+    g1_states = pivot_matrix(cn_g1, cols.cn_state_col, cols)
+
+    has_s_states = cols.cn_state_col in cn_s.columns
+    s_states = pivot_matrix(cn_s, cols.cn_state_col, cols) if has_s_states else None
+
+    # loci fully observed in every pivot (reference drops NaN columns
+    # independently and asserts equality, pert_model.py:148-154)
+    loci = s_reads.dropna(axis=1).columns
+    loci = loci.intersection(g1_reads.dropna(axis=1).columns)
+    loci = loci.intersection(g1_states.dropna(axis=1).columns)
+    if s_states is not None:
+        loci = loci.intersection(s_states.dropna(axis=1).columns)
+    loci = loci.sortlevel([0, 1])[0]
+
+    s_reads = s_reads[loci]
+    g1_reads = g1_reads[loci]
+    g1_states = g1_states[loci]
+    if s_states is not None:
+        s_states = s_states[loci]
+
+    libs_s, libs_g1, library_ids = _library_index(cn_s, cn_g1, cols)
+
+    gammas = _per_locus_profile(cn_s, cols.gc_col, loci, cols)
+    if gammas is None:
+        raise ValueError(f"GC column {cols.gc_col!r} is required in cn_s")
+
+    rt_prior = _per_locus_profile(cn_s, cols.rt_prior_col, loci, cols)
+    if rt_prior is not None:
+        # early RT ~ 1, late RT ~ 0 (reference: pert_model.py:254-257)
+        rt_prior = rt_prior / rt_prior.max()
+
+    def _to_f32_int(mat: pd.DataFrame) -> np.ndarray:
+        # int64 truncation before float32 matches the reference
+        # (pert_model.py:161-166)
+        return mat.to_numpy().astype(np.int64).astype(np.float32)
+
+    def _make(reads_df, states_df, libs) -> PertData:
+        cell_ids = list(reads_df.index)
+        return PertData(
+            reads=_to_f32_int(reads_df),
+            states=None if states_df is None else _to_f32_int(states_df),
+            libs=libs.reindex(cell_ids).to_numpy(dtype=np.int32),
+            gammas=gammas,
+            rt_prior=rt_prior,
+            cell_ids=cell_ids,
+            loci=loci,
+            library_ids=library_ids,
+            cell_mask=np.ones(len(cell_ids), dtype=bool),
+        )
+
+    return _make(s_reads, s_states, libs_s), _make(g1_reads, g1_states, libs_g1)
+
+
+def pad_cells(data: PertData, multiple: int) -> PertData:
+    """Pad the cells axis to a multiple of ``multiple`` with masked cells.
+
+    Padding keeps shapes static for XLA and lets the cells axis shard
+    evenly over a device mesh; padded cells carry ``cell_mask=False`` and
+    contribute zero to every masked reduction in the compiled loss.
+    """
+    n = data.num_cells
+    target = ((n + multiple - 1) // multiple) * multiple
+    if target == n:
+        return data
+    pad = target - n
+
+    def _pad_mat(x):
+        if x is None:
+            return None
+        return np.concatenate([x, np.ones((pad, x.shape[1]), x.dtype)], axis=0)
+
+    return dataclasses.replace(
+        data,
+        reads=_pad_mat(data.reads),
+        states=_pad_mat(data.states),
+        libs=np.concatenate([data.libs, np.zeros(pad, data.libs.dtype)]),
+        cell_ids=list(data.cell_ids) + [f"__pad_{i}__" for i in range(pad)],
+        cell_mask=np.concatenate([data.cell_mask, np.zeros(pad, dtype=bool)]),
+    )

@@ -1,0 +1,3 @@
+from scdna_replication_tools_tpu.infer.svi import FitResult, fit_map
+
+__all__ = ["FitResult", "fit_map"]

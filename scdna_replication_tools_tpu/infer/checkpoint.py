@@ -1,0 +1,41 @@
+"""Step-boundary checkpointing of fitted parameter pytrees.
+
+The reference has no checkpoint/resume at all — learned state crosses the
+three SVI steps only in-memory (reference: pert_model.py:772-787, 836-851).
+Step boundaries are natural checkpoints, so the TPU runner persists the
+fitted (unconstrained) parameter dict, loss history and RNG-free metadata
+after each step as a flat ``.npz``; a rerun resumes from the last
+completed step.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+
+def save_step(checkpoint_dir: str, step: str, params: dict,
+              losses: np.ndarray, extra: Optional[dict] = None) -> str:
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    path = os.path.join(checkpoint_dir, f"pert_{step}.npz")
+    flat = {f"param.{k}": np.asarray(v) for k, v in params.items()}
+    flat["losses"] = np.asarray(losses)
+    for k, v in (extra or {}).items():
+        flat[f"extra.{k}"] = np.asarray(v)
+    np.savez(path, **flat)
+    return path
+
+
+def load_step(checkpoint_dir: str, step: str):
+    """Returns (params, losses, extra) or None if the checkpoint is absent."""
+    path = os.path.join(checkpoint_dir, f"pert_{step}.npz")
+    if not os.path.exists(path):
+        return None
+    data = np.load(path)
+    params = {k[len("param."):]: data[k] for k in data.files
+              if k.startswith("param.")}
+    extra = {k[len("extra."):]: data[k] for k in data.files
+             if k.startswith("extra.")}
+    return params, data["losses"], extra

@@ -1,0 +1,398 @@
+"""Three-step PERT inference driver.
+
+TPU-native re-design of ``pert_infer_scRT.run_pert_model``
+(reference: pert_model.py:649-901):
+
+  Step 1 — G1/2 cells, each doubled as G1 (rep=0) and G2 (rep=1)
+           (reference: pert_model.py:228-251, 718-729), cn/rep observed;
+           learns lambda + per-library GC beta means/stds.
+  Step 2 — S cells with cn/rep enumerated; beta_means conditioned from
+           step 1, lambda fixed; learns rho, a, tau, u, betas, pi
+           (reference: pert_model.py:777-830).
+  Step 3 — (optional) the pre-trained S model applied to the G1/2 cells
+           with rho/a/beta_means conditioned, clone-consensus CN prior
+           (reference: pert_model.py:832-899), to catch mislabelled phases.
+
+Each step is one compiled ``lax.while_loop`` fit (see ``infer.svi``); step
+transitions pass fitted values as conditioning arrays, and every step
+boundary is checkpointed (the reference keeps all state in memory only).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from scdna_replication_tools_tpu.config import ColumnConfig, PertConfig
+from scdna_replication_tools_tpu.data.loader import (
+    PertData,
+    build_pert_inputs,
+    pad_cells,
+)
+from scdna_replication_tools_tpu.infer import checkpoint as ckpt
+from scdna_replication_tools_tpu.infer.svi import FitResult, fit_map
+from scdna_replication_tools_tpu.models import priors
+from scdna_replication_tools_tpu.models.pert import (
+    PertBatch,
+    PertModelSpec,
+    constrained,
+    decode_discrete,
+    init_params,
+    pert_loss,
+)
+from scdna_replication_tools_tpu.ops.gc import gc_features
+from scdna_replication_tools_tpu.ops.stats import guess_times, pearson_matrix
+from scdna_replication_tools_tpu.parallel.mesh import (
+    make_mesh,
+    shard_batch,
+    shard_params,
+)
+
+
+def _pad_etas(etas: np.ndarray, target_cells: int) -> np.ndarray:
+    """Pad the cells axis of an etas tensor with a diploid-concentrated
+    prior.  Padding with all-ones would make the ploidy guess (argmax of
+    etas) zero for the pad cells and NaN the masked loss (see
+    models/pert.py ``_cell_ploidies``); a concentrated diploid row keeps
+    every term finite while the mask zeroes its contribution."""
+    if etas.shape[0] == target_cells:
+        return etas
+    pad = target_cells - etas.shape[0]
+    pad_row = np.ones(etas.shape[1:], etas.dtype)
+    pad_row[..., min(2, etas.shape[-1] - 1)] = 100.0
+    return np.concatenate(
+        [etas, np.broadcast_to(pad_row, (pad,) + etas.shape[1:])], axis=0)
+
+
+@dataclasses.dataclass
+class StepOutput:
+    fit: FitResult
+    spec: PertModelSpec
+    fixed: dict
+    batch: PertBatch
+    wall_time: float
+
+
+class PertInference:
+    """Orchestrates the three SVI steps on dense inputs.
+
+    ``clone_idx_s`` / ``clone_idx_g1`` are dense integer clone assignments
+    aligned with the cell axes of ``s_data`` / ``g1_data`` (the pandas
+    facade produces them from ``clone_col``).
+    """
+
+    def __init__(
+        self,
+        s_data: PertData,
+        g1_data: PertData,
+        config: PertConfig = PertConfig(),
+        clone_idx_s: Optional[np.ndarray] = None,
+        clone_idx_g1: Optional[np.ndarray] = None,
+        num_clones: int = 0,
+    ):
+        self.s = s_data
+        self.g1 = g1_data
+        self.config = config
+        self.clone_idx_s = clone_idx_s
+        self.clone_idx_g1 = clone_idx_g1
+        self.num_clones = num_clones
+        self.L = s_data.num_libraries
+        self._mesh = None
+        if config.num_shards is None or config.num_shards == 0:
+            # None/0 = use every local device
+            self._mesh = make_mesh()
+        elif config.num_shards > 1:
+            self._mesh = make_mesh(config.num_shards)
+
+    # -- batches ----------------------------------------------------------
+
+    def _gamma_feats(self) -> jnp.ndarray:
+        return gc_features(jnp.asarray(self.s.gammas), self.config.K)
+
+    def _maybe_shard(self, batch: PertBatch, params: dict):
+        if self._mesh is None:
+            return batch, params
+        return shard_batch(self._mesh, batch), shard_params(self._mesh, params)
+
+    def _pad(self, data: PertData) -> PertData:
+        mult = 1
+        if self._mesh is not None:
+            mult *= self._mesh.devices.size
+        if self.config.cell_chunk:
+            assert self._mesh is None, (
+                "cell_chunk is a single-device memory knob; use sharding "
+                "for multi-device runs")
+            mult *= self.config.cell_chunk
+        return pad_cells(data, mult) if mult > 1 else data
+
+    def g1_g2_doubled_batch(self) -> Tuple[PertBatch, PertData]:
+        """Step-1 batch: every G1 cell appears as G1 (rep=0) and G2 (rep=1).
+
+        Mirrors ``make_g1_g2_training_data`` (reference:
+        pert_model.py:228-251) on the cells axis.
+        """
+        g1 = self._pad(self.g1)
+        reads = np.concatenate([g1.reads, g1.reads], axis=0)
+        states = np.concatenate([g1.states, g1.states], axis=0)
+        libs = np.concatenate([g1.libs, g1.libs])
+        mask = np.concatenate([g1.cell_mask, g1.cell_mask]).astype(np.float32)
+        rep = np.concatenate([
+            np.zeros_like(g1.reads), np.ones_like(g1.reads)], axis=0)
+        batch = PertBatch(
+            reads=jnp.asarray(reads),
+            libs=jnp.asarray(libs),
+            gamma_feats=self._gamma_feats(),
+            mask=jnp.asarray(mask),
+            cn_obs=jnp.asarray(states),
+            rep_obs=jnp.asarray(rep),
+        )
+        return batch, g1
+
+    # -- CN priors --------------------------------------------------------
+
+    def build_etas(self) -> np.ndarray:
+        """CN prior concentrations for the S cells, per ``cn_prior_method``
+        (reference: pert_model.py:668-716)."""
+        cfg = self.config
+        method = cfg.cn_prior_method
+        P = cfg.P
+        s = self.s
+        num_cells, num_loci = s.reads.shape
+
+        if method == "hmmcopy":
+            if s.states is None:
+                raise ValueError("hmmcopy prior requires S-phase CN states")
+            return priors.cn_prior_from_states(s.states, P, cfg.cn_prior_weight)
+
+        if method == "diploid":
+            dip = np.full((num_cells, num_loci), 2.0, np.float32)
+            return priors.cn_prior_from_states(dip, P, cfg.cn_prior_weight)
+
+        if method in ("g1_cells", "g1_clones", "g1_composite"):
+            clone_profiles = priors.consensus_clone_profiles(
+                self.g1.states, self.clone_idx_g1, self.num_clones,
+                states=self.g1.states)
+            if method == "g1_clones":
+                return priors.clone_cn_prior(
+                    self.clone_idx_s, clone_profiles, P, cfg.cn_prior_weight)
+            if method == "g1_composite":
+                return priors.composite_cn_prior(
+                    s.reads, self.clone_idx_s, self.g1.reads, self.g1.states,
+                    self.clone_idx_g1, clone_profiles, P, J=cfg.J)
+            # g1_cells: single best-correlated G1 cell's states
+            # (reference: pert_model.py:671-701)
+            corr = np.asarray(pearson_matrix(s.reads, self.g1.reads))
+            if self.clone_idx_s is not None:
+                same = self.clone_idx_s[:, None] == self.clone_idx_g1[None, :]
+                corr = np.where(same, corr, -np.inf)
+            best = np.argmax(corr, axis=1)
+            return priors.cn_prior_from_states(
+                self.g1.states[best], P, cfg.cn_prior_weight)
+
+        # uniform fallback (reference: pert_model.py:713-716)
+        return priors.uniform_prior(num_cells, num_loci, P)
+
+    def build_etas_step3(self) -> np.ndarray:
+        """Clone-consensus prior for the G1 cells (reference:
+        pert_model.py:853-854)."""
+        clone_profiles = priors.consensus_clone_profiles(
+            self.g1.states, self.clone_idx_g1, self.num_clones,
+            states=self.g1.states)
+        return priors.clone_cn_prior(
+            self.clone_idx_g1, clone_profiles, self.config.P,
+            self.config.cn_prior_weight)
+
+    # -- steps ------------------------------------------------------------
+
+    def _fit(self, spec, batch, fixed, t_init, max_iter, min_iter,
+             step_name) -> StepOutput:
+        cfg = self.config
+        if cfg.checkpoint_dir:
+            restored = ckpt.load_step(cfg.checkpoint_dir, step_name)
+            if restored is not None:
+                params, losses, _ = restored
+                params = {k: jnp.asarray(v) for k, v in params.items()}
+                fit = FitResult(params=params, losses=losses,
+                                num_iters=len(losses), converged=True,
+                                nan_abort=False)
+                return StepOutput(fit, spec, fixed, batch, 0.0)
+
+        params0 = init_params(spec, batch, fixed, t_init=t_init)
+        batch, params0 = self._maybe_shard(batch, params0)
+
+        def loss_fn(params, fixed, batch):
+            return pert_loss(spec, params, fixed, batch)
+
+        t0 = time.perf_counter()
+        fit = fit_map(loss_fn, params0, (fixed, batch),
+                      max_iter=max_iter, min_iter=min_iter,
+                      rel_tol=cfg.rel_tol, learning_rate=cfg.learning_rate,
+                      b1=cfg.adam_b1, b2=cfg.adam_b2)
+        wall = time.perf_counter() - t0
+
+        if cfg.checkpoint_dir:
+            ckpt.save_step(cfg.checkpoint_dir, step_name,
+                           jax.tree_util.tree_map(np.asarray, fit.params),
+                           fit.losses)
+        return StepOutput(fit, spec, fixed, batch, wall)
+
+    def run_step1(self) -> StepOutput:
+        iters = self.config.resolved_iters()
+        batch, _ = self.g1_g2_doubled_batch()
+        spec = PertModelSpec(
+            P=self.config.P, K=self.config.K, L=self.L,
+            tau_mode="beta_default", step1=True,
+            cell_chunk=self.config.cell_chunk)
+        return self._fit(spec, batch, {}, None,
+                         iters["max_iter_step1"], iters["min_iter_step1"],
+                         "step1")
+
+    def run_step2(self, step1: StepOutput, etas: np.ndarray) -> StepOutput:
+        iters = self.config.resolved_iters()
+        c1 = constrained(step1.spec, step1.fit.params, step1.fixed)
+        fixed = {
+            "beta_means": c1["beta_means"],   # pert_model.py:782-787
+            "lamb": c1["lamb"],               # pert_model.py:801 (lamb=...)
+        }
+        s = self._pad(self.s)
+        etas_padded = _pad_etas(etas, s.num_cells)
+        t_init, _, _ = guess_times(jnp.asarray(s.reads),
+                                   jnp.asarray(etas_padded),
+                                   float(self.config.upsilon))
+        batch = PertBatch(
+            reads=jnp.asarray(s.reads),
+            libs=jnp.asarray(s.libs),
+            gamma_feats=self._gamma_feats(),
+            mask=jnp.asarray(s.cell_mask.astype(np.float32)),
+            etas=jnp.asarray(etas_padded),
+        )
+        spec = PertModelSpec(
+            P=self.config.P, K=self.config.K, L=self.L,
+            tau_mode="param", step1=False, cond_beta_means=True,
+            fixed_lamb=True, cell_chunk=self.config.cell_chunk)
+        out = self._fit(spec, batch, fixed, t_init,
+                        iters["max_iter"], iters["min_iter"], "step2")
+        self._step2_data = s
+        return out
+
+    def run_step3(self, step1: StepOutput, step2: StepOutput) -> StepOutput:
+        iters = self.config.resolved_iters()
+        c1 = constrained(step1.spec, step1.fit.params, step1.fixed)
+        c2 = constrained(step2.spec, step2.fit.params, step2.fixed)
+        fixed = {
+            "beta_means": c1["beta_means"],
+            "lamb": c1["lamb"],
+            "rho": c2["rho"],                 # pert_model.py:844-851
+            "a": c2["a"],
+        }
+        g1 = self._pad(self.g1)
+        etas2 = _pad_etas(self.build_etas_step3(), g1.num_cells)
+        t_init2, _, _ = guess_times(jnp.asarray(g1.reads),
+                                    jnp.asarray(etas2),
+                                    float(self.config.upsilon))
+        batch = PertBatch(
+            reads=jnp.asarray(g1.reads),
+            libs=jnp.asarray(g1.libs),
+            gamma_feats=self._gamma_feats(),
+            mask=jnp.asarray(g1.cell_mask.astype(np.float32)),
+            etas=jnp.asarray(etas2),
+        )
+        spec = PertModelSpec(
+            P=self.config.P, K=self.config.K, L=self.L,
+            tau_mode="param", step1=False, cond_beta_means=True,
+            cond_rho=True, cond_a=True, fixed_lamb=True,
+            cell_chunk=self.config.cell_chunk)
+        out = self._fit(spec, batch, fixed, t_init2,
+                        iters["max_iter_step3"], iters["min_iter_step3"],
+                        "step3")
+        self._step3_data = g1
+        return out
+
+    # -- full pipeline ----------------------------------------------------
+
+    def run(self):
+        """Run steps 1-3; returns (step1, step2, step3-or-None)."""
+        step1 = self.run_step1()
+        etas = self.build_etas()
+        step2 = self.run_step2(step1, etas)
+        step3 = self.run_step3(step1, step2) if self.config.run_step3 else None
+        return step1, step2, step3
+
+
+# ---------------------------------------------------------------------------
+# output packaging (pandas parity)
+# ---------------------------------------------------------------------------
+
+def package_step_output(
+    cn_long: pd.DataFrame,
+    data: PertData,
+    step: StepOutput,
+    lamb: float,
+    losses_g: np.ndarray,
+    losses_s: np.ndarray,
+    cols: ColumnConfig = ColumnConfig(),
+) -> Tuple[pd.DataFrame, pd.DataFrame]:
+    """Decode discretes + melt fitted values back to the long-form contract.
+
+    Mirrors ``package_s_output`` (reference: pert_model.py:466-538): adds
+    model_cn_state, model_rep_state, model_tau, model_u, model_rho columns
+    to ``cn_long`` and builds the supplementary param/loss table
+    (model_lambda, model_a, loss_g, loss_s).
+    """
+    spec, params, fixed, batch = step.spec, step.fit.params, step.fixed, step.batch
+    cn_map, rep_map, p_rep = decode_discrete(spec, params, fixed, batch)
+    c = constrained(spec, params, fixed)
+
+    n = int(np.sum(data.cell_mask)) if data.cell_mask is not None \
+        else data.num_cells
+    cell_ids = list(data.cell_ids)[:n]
+    chr_vals = data.loci.get_level_values(0).astype(str)
+    start_vals = data.loci.get_level_values(1)
+
+    loci_index = pd.MultiIndex.from_arrays(
+        [chr_vals, start_vals], names=[cols.chr_col, cols.start_col])
+
+    def _melt(mat, name):
+        # loci x cells frame melted to long form, like the reference's
+        # model_cn_df/model_rep_df handling (pert_model.py:480-483)
+        df = pd.DataFrame(np.asarray(mat)[:n].T, index=loci_index,
+                          columns=pd.Index(cell_ids, name=cols.cell_col))
+        return df.melt(ignore_index=False, value_name=name).reset_index()
+
+    cn_long = cn_long.copy()
+    cn_long[cols.chr_col] = cn_long[cols.chr_col].astype(str)
+
+    out = pd.merge(cn_long, _melt(cn_map, "model_cn_state"))
+    out = pd.merge(out, _melt(rep_map, "model_rep_state"))
+    out = pd.merge(out, _melt(p_rep, "model_p_rep"))
+
+    tau_df = pd.DataFrame({cols.cell_col: cell_ids,
+                           "model_tau": np.asarray(c["tau"])[:n]})
+    u_df = pd.DataFrame({cols.cell_col: cell_ids,
+                         "model_u": np.asarray(c["u"])[:n]})
+    rho_df = pd.DataFrame({cols.chr_col: chr_vals,
+                           cols.start_col: start_vals,
+                           "model_rho": np.asarray(c["rho"])})
+    out = pd.merge(out, tau_df)
+    out = pd.merge(out, u_df)
+    out = pd.merge(out, rho_df)
+
+    supp = [
+        pd.DataFrame({"param": ["model_lambda"], "level": ["all"],
+                      "value": [float(lamb)]}),
+        pd.DataFrame({"param": ["model_a"], "level": ["all"],
+                      "value": [float(np.asarray(c["a"]).reshape(-1)[0])]}),
+        pd.DataFrame({"param": ["loss_g"] * len(losses_g),
+                      "level": np.arange(len(losses_g)),
+                      "value": np.asarray(losses_g, np.float64)}),
+        pd.DataFrame({"param": ["loss_s"] * len(losses_s),
+                      "level": np.arange(len(losses_s)),
+                      "value": np.asarray(losses_s, np.float64)}),
+    ]
+    return out, pd.concat(supp, ignore_index=True)

@@ -1,0 +1,15 @@
+from scdna_replication_tools_tpu.models.pert import (
+    PertBatch,
+    PertModelSpec,
+    init_params,
+    pert_loss,
+    decode_discrete,
+)
+
+__all__ = [
+    "PertBatch",
+    "PertModelSpec",
+    "init_params",
+    "pert_loss",
+    "decode_discrete",
+]

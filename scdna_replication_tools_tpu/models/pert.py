@@ -1,0 +1,437 @@
+"""The PERT graphical model as a pure-JAX MAP + enumeration objective.
+
+TPU-first re-design of the Pyro model ``pert_infer_scRT.model_s``
+(reference: pert_model.py:541-646).  The reference pairs the model with an
+AutoDelta (point-mass) guide and marginalises the two discrete sites by
+Pyro parallel enumeration under ``JitTraceEnum_ELBO``
+(reference: pert_model.py:732-735, 792-795).  With a delta guide the ELBO
+is *deterministic*: it equals the log-joint density at the current point
+estimates with the discrete sites summed out.  So instead of re-creating
+Pyro's messenger machinery we compute that objective directly:
+
+    loss = -[ sum_{cell, locus} logsumexp_{cn in 0..P-1, rep in 0,1}
+                ( log pi[cell, locus, cn]
+                + log Bernoulli(rep | phi[cell, locus])
+                + log NB(reads[cell, locus] | delta(cn, rep), lambda) )
+            + log-priors of the continuous sites at their point values ]
+
+The (P, 2) enumeration lives as two trailing broadcast axes of one dense
+(cells, loci, P, 2) tensor — XLA fuses the NB log-pmf, the sigmoid
+replication probability and the logsumexp into a single elementwise+reduce
+kernel, and the tensor is the natural unit for sharding cells across a TPU
+mesh.  ``infer_discrete(temperature=0)`` (reference: pert_model.py:766-769,
+824-827) becomes an argmax over the same joint logits.
+
+Layout: arrays are (cells, loci) — cells is the batch/shard axis (the
+reference uses (loci, cells) for Pyro plate bookkeeping).
+
+Site-type semantics preserved from the reference (they affect the loss):
+
+* ``expose_lambda`` and ``expose_beta_stds`` are pyro **params** — no prior
+  term ever (reference: pert_model.py:556-562); ``beta_stds`` is freshly
+  re-optimised in every step because ``poutine.condition`` only fixes
+  *sample* sites and the param store is cleared between steps
+  (reference: pert_model.py:778, 839-851).
+* ``expose_tau`` is a param (no prior) when ``t_init`` is given — the
+  branch actually used in all three steps (reference: pert_model.py:580-585,
+  801, 868) — and a Beta sample site otherwise.
+* conditioned sample sites (beta_means in steps 2/3; rho, a in step 3; cn,
+  rep in step 1) remain *observed* sites whose log-prob still enters the
+  loss (constant in the fixed value).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.scipy.special import logsumexp
+
+from scdna_replication_tools_tpu.ops.dists import (
+    bernoulli_log_prob,
+    beta_log_prob,
+    dirichlet_log_prob,
+    gamma_log_prob,
+    nb_log_prob,
+    normal_log_prob,
+)
+from scdna_replication_tools_tpu.ops.gc import gc_features, gc_rate
+from scdna_replication_tools_tpu.ops.transforms import (
+    from_interval,
+    from_positive,
+    from_unit_interval,
+    to_interval,
+    to_positive,
+    to_unit_interval,
+)
+
+LAMB_LO, LAMB_HI = 0.001, 0.999   # reference: pert_model.py:557
+PHI_LO, PHI_HI = 0.001, 0.999     # reference: pert_model.py:621-623
+
+
+@dataclasses.dataclass(frozen=True)
+class PertModelSpec:
+    """Static model configuration (hashable; safe to close over under jit).
+
+    ``tau_mode`` selects the reference's tau branch
+    (reference: pert_model.py:580-585): 'param' (t_init given — the branch
+    used by ``run_pert_model``), 'beta_prior' (t_alpha/t_beta given) or
+    'beta_default' (Beta(1.5, 1.5)).
+    ``step1`` switches cn/rep from enumerated latents to observed values
+    (the poutine.condition of step 1, reference: pert_model.py:724-729).
+    """
+
+    P: int = 13
+    K: int = 4
+    L: int = 1
+    tau_mode: str = "param"
+    step1: bool = False
+    # sample sites conditioned to fixed arrays (still contribute priors)
+    cond_beta_means: bool = False
+    cond_rho: bool = False
+    cond_a: bool = False
+    # lambda fixed as a plain argument (no site at all) — steps 2/3
+    fixed_lamb: bool = False
+    cell_chunk: Optional[int] = None
+
+
+class PertBatch:
+    """Dense device inputs for one model fit.
+
+    Attributes (all jnp arrays):
+      reads      (cells, loci) float32
+      libs       (cells,) int32
+      gamma_feats(loci, K+1) float32 — precomputed GC polynomial features
+      mask       (cells,) float32 — 1 for real cells, 0 for padding
+      etas       (cells, loci, P) float32 or None — CN prior concentrations
+      cn_obs     (cells, loci) float32 or None — step-1 conditioned CN
+      rep_obs    (cells, loci) float32 or None — step-1 conditioned rep
+      t_alpha, t_beta (cells,) or None — Beta prior for tau ('beta_prior')
+    """
+
+    def __init__(self, reads, libs, gamma_feats, mask, etas=None,
+                 cn_obs=None, rep_obs=None, t_alpha=None, t_beta=None):
+        self.reads = reads
+        self.libs = libs
+        self.gamma_feats = gamma_feats
+        self.mask = mask
+        self.etas = etas
+        self.cn_obs = cn_obs
+        self.rep_obs = rep_obs
+        self.t_alpha = t_alpha
+        self.t_beta = t_beta
+
+    def tree_flatten(self):
+        children = (self.reads, self.libs, self.gamma_feats, self.mask,
+                    self.etas, self.cn_obs, self.rep_obs, self.t_alpha,
+                    self.t_beta)
+        return children, None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    PertBatch, PertBatch.tree_flatten, PertBatch.tree_unflatten
+)
+
+
+# ---------------------------------------------------------------------------
+# parameter initialisation
+# ---------------------------------------------------------------------------
+
+def init_params(spec: PertModelSpec, batch: PertBatch, fixed: dict,
+                t_init: Optional[np.ndarray] = None) -> dict:
+    """Initial unconstrained parameter pytree.
+
+    Follows AutoDelta's init-at-prior-median behaviour for sample sites and
+    the explicit inits of the param sites: lambda_init = 0.1
+    (reference: pert_model.py:542, 557), beta_stds = logspace(1 -> 10^-K)
+    (reference: pert_model.py:561-562), tau = t_init
+    (reference: pert_model.py:583).
+    """
+    num_cells, num_loci = batch.reads.shape
+    Kp1 = spec.K + 1
+    params: dict = {}
+
+    if not spec.cond_a:
+        # Gamma(2, 0.2) median ~ 8.39 (prior for `a`, pert_model.py:553)
+        params["a_raw"] = from_positive(8.3917)
+    if not spec.fixed_lamb:
+        params["lamb_raw"] = from_interval(0.1, LAMB_LO, LAMB_HI)
+    if not spec.cond_beta_means:
+        params["beta_means"] = jnp.zeros((spec.L, Kp1), jnp.float32)
+    params["beta_stds_raw"] = from_positive(
+        jnp.tile(jnp.logspace(0.0, -spec.K, Kp1, dtype=jnp.float32), (spec.L, 1))
+    )
+    if not spec.cond_rho:
+        params["rho_raw"] = jnp.full((num_loci,), from_unit_interval(0.5))
+
+    if spec.tau_mode == "param":
+        t0 = jnp.asarray(t_init, jnp.float32) if t_init is not None \
+            else jnp.full((num_cells,), 0.5, jnp.float32)
+        params["tau_raw"] = from_unit_interval(jnp.clip(t0, 1e-4, 1.0 - 1e-4))
+    elif spec.tau_mode == "beta_prior":
+        mean = batch.t_alpha / (batch.t_alpha + batch.t_beta)
+        params["tau_raw"] = from_unit_interval(jnp.clip(mean, 1e-4, 1.0 - 1e-4))
+    else:
+        params["tau_raw"] = jnp.full((num_cells,), from_unit_interval(0.5))
+
+    # u init at the prior median u_guess evaluated at the initial tau
+    tau0 = to_unit_interval(params["tau_raw"])
+    ploidies0 = _cell_ploidies(spec, batch)
+    u_guess0 = jnp.mean(batch.reads, axis=1) / ((1.0 + tau0) * ploidies0)
+    params["u"] = u_guess0.astype(jnp.float32)
+
+    beta_means0 = fixed["beta_means"] if spec.cond_beta_means else params["beta_means"]
+    params["betas"] = jnp.asarray(beta_means0)[batch.libs].astype(jnp.float32)
+
+    if not spec.step1 and batch.etas is not None:
+        pi0 = batch.etas / jnp.sum(batch.etas, axis=-1, keepdims=True)
+        params["pi_logits"] = jnp.log(jnp.clip(pi0, 1e-30, None))
+    else:
+        params["pi_logits"] = jnp.zeros((num_cells, num_loci, spec.P), jnp.float32)
+
+    return params
+
+
+def _cell_ploidies(spec: PertModelSpec, batch: PertBatch) -> jnp.ndarray:
+    """Per-cell ploidy guess feeding the u prior (reference:
+    pert_model.py:589-600): argmax of etas when provided, else 2.0.
+    (cn0 is only ever supplied by the simulator.)"""
+    if batch.etas is not None and not spec.step1:
+        cn_mode = jnp.argmax(batch.etas, axis=-1).astype(jnp.float32)
+        return jnp.mean(cn_mode, axis=1)
+    return jnp.full((batch.reads.shape[0],), 2.0, jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# constrained views
+# ---------------------------------------------------------------------------
+
+def constrained(spec: PertModelSpec, params: dict, fixed: dict) -> dict:
+    """Materialise constrained-space values for every site."""
+    out = {}
+    out["a"] = jnp.asarray(fixed["a"]) if spec.cond_a else to_positive(params["a_raw"])
+    out["lamb"] = jnp.asarray(fixed["lamb"]) if spec.fixed_lamb \
+        else to_interval(params["lamb_raw"], LAMB_LO, LAMB_HI)
+    out["beta_means"] = jnp.asarray(fixed["beta_means"]) if spec.cond_beta_means \
+        else params["beta_means"]
+    out["beta_stds"] = to_positive(params["beta_stds_raw"])
+    out["rho"] = jnp.asarray(fixed["rho"]) if spec.cond_rho \
+        else to_unit_interval(params["rho_raw"])
+    out["tau"] = to_unit_interval(params["tau_raw"])
+    out["u"] = params["u"]
+    out["betas"] = params["betas"]
+    out["pi"] = jax.nn.softmax(params["pi_logits"], axis=-1)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# log-joint
+# ---------------------------------------------------------------------------
+
+def _global_log_prior(spec: PertModelSpec, c: dict) -> jnp.ndarray:
+    """Priors of the global (non-plated) sample sites."""
+    lp = jnp.sum(gamma_log_prob(c["a"], 2.0, 0.2))      # pert_model.py:553
+    lp += jnp.sum(normal_log_prob(c["beta_means"], 0.0, 1.0))  # :560
+    # rho ~ Beta(1,1): log pdf is identically 0 on (0,1) (pert_model.py:574)
+    return lp
+
+
+def _per_cell_log_prior(spec: PertModelSpec, c: dict, batch: PertBatch,
+                        reads_mean: jnp.ndarray, ploidies: jnp.ndarray) -> jnp.ndarray:
+    """(cells,) prior terms for tau, u and betas."""
+    tau, u, betas = c["tau"], c["u"], c["betas"]
+    lp = jnp.zeros_like(tau)
+    if spec.tau_mode == "beta_prior":
+        lp += beta_log_prob(tau, batch.t_alpha, batch.t_beta)   # :581
+    elif spec.tau_mode == "beta_default":
+        lp += beta_log_prob(tau, 1.5, 1.5)                      # :585
+    # tau_mode == 'param': pyro.param site, no prior (:583)
+
+    # denominator clamped away from 0: a degenerate all-zero CN prior (or a
+    # padded cell) would otherwise produce u_guess = inf and NaN the whole
+    # loss — the reference NaN-aborts in that case (pert_model.py:755-758),
+    # we degrade to a huge-but-finite prior mean instead
+    denom = jnp.maximum((1.0 + tau) * ploidies, 1e-6)
+    u_guess = reads_mean / denom                                # :597
+    u_stdev = u_guess / 10.0                                    # :598
+    lp += normal_log_prob(u, u_guess, jnp.maximum(u_stdev, 1e-12))  # :600
+
+    bm = c["beta_means"][batch.libs]                            # (cells, K+1)
+    bs = c["beta_stds"][batch.libs]
+    lp += jnp.sum(normal_log_prob(betas, bm, bs), axis=-1)      # :603
+    return lp
+
+
+def _phi(c: dict, num_loci: int) -> jnp.ndarray:
+    """(cells, loci) replication probability phi = sigmoid(a (tau - rho)),
+    clamped to [0.001, 0.999] (reference: pert_model.py:616-623)."""
+    t_diff = c["tau"][:, None] - c["rho"][None, :]
+    phi = jax.nn.sigmoid(c["a"] * t_diff)
+    return jnp.clip(phi, PHI_LO, PHI_HI)
+
+
+def _nb_pieces(c: dict):
+    lamb = c["lamb"]
+    log_lamb = jnp.log(lamb)
+    log1m_lamb = jnp.log1p(-lamb)
+    return lamb, log_lamb, log1m_lamb
+
+
+def _joint_logits(P, reads, u, omega, log_pi, phi, lamb, log_lamb,
+                  log1m_lamb):
+    """(cells, loci, P, 2) joint logits of the enumerated discrete sites.
+
+    log pi[cn] + log Bernoulli(rep | phi) + log NB(reads | delta(cn, rep))
+    with the (P, 2) state product as trailing broadcast axes (Pyro parallel
+    enumeration of 'cn' and 'rep', reference: pert_model.py:611-646).
+    Shared by the training objective (logsumexp) and the MAP decode
+    (argmax) so the two can never disagree.
+    """
+    chi = jnp.arange(P, dtype=jnp.float32)[:, None] * \
+        (1.0 + jnp.arange(2, dtype=jnp.float32))[None, :]        # (P, 2)
+    theta = (u[:, None] * omega)[..., None, None] * chi          # (c, l, P, 2)
+    delta = jnp.maximum(theta * (1.0 - lamb) / lamb, 1.0)        # :640-644
+    nb = nb_log_prob(reads[..., None, None], delta, log_lamb, log1m_lamb)
+    bern = jnp.stack([jnp.log1p(-phi), jnp.log(phi)], axis=-1)   # (c, l, 2)
+    return log_pi[..., :, None] + bern[..., None, :] + nb
+
+
+def _enum_bin_loglik(spec, reads, u, omega, log_pi, phi, lamb, log_lamb,
+                     log1m_lamb):
+    """(cells, loci) enumerated bin log-likelihood (states summed out)."""
+    joint = _joint_logits(spec.P, reads, u, omega, log_pi, phi, lamb,
+                          log_lamb, log1m_lamb)
+    return logsumexp(joint, axis=(-2, -1))
+
+
+def _observed_bin_loglik(spec, reads, u, omega, log_pi, phi, cn_obs, rep_obs,
+                         lamb, log_lamb, log1m_lamb):
+    """(cells, loci) bin log-likelihood with cn/rep conditioned (step 1)."""
+    cn_idx = cn_obs.astype(jnp.int32)
+    lp_cn = jnp.take_along_axis(log_pi, cn_idx[..., None], axis=-1)[..., 0]
+    lp_rep = bernoulli_log_prob(rep_obs, phi)
+    theta = u[:, None] * omega * cn_obs * (1.0 + rep_obs)
+    delta = jnp.maximum(theta * (1.0 - lamb) / lamb, 1.0)
+    lp_reads = nb_log_prob(reads, delta, log_lamb, log1m_lamb)
+    return lp_cn + lp_rep + lp_reads
+
+
+def log_joint(spec: PertModelSpec, params: dict, fixed: dict,
+              batch: PertBatch) -> jnp.ndarray:
+    """Total log-joint (the negative of the SVI loss), discretes summed out."""
+    c = constrained(spec, params, fixed)
+    lamb, log_lamb, log1m_lamb = _nb_pieces(c)
+    num_cells, num_loci = batch.reads.shape
+    mask = batch.mask
+
+    lp = _global_log_prior(spec, c)
+
+    reads_mean = jnp.mean(batch.reads, axis=1)
+    ploidies = _cell_ploidies(spec, batch)
+    lp += jnp.sum(_per_cell_log_prior(spec, c, batch, reads_mean, ploidies) * mask)
+
+    # pi ~ Dirichlet(etas) per (cell, locus) (reference: pert_model.py:608-611)
+    etas = batch.etas if batch.etas is not None else \
+        jnp.ones((num_cells, num_loci, spec.P), jnp.float32)
+    log_pi = jnp.log(c["pi"])
+    lp_pi = dirichlet_log_prob(c["pi"], etas, axis=-1)
+    lp += jnp.sum(lp_pi * mask[:, None])
+
+    phi = _phi(c, num_loci)
+    omega = gc_rate(c["betas"], batch.gamma_feats)               # :632-633
+
+    def bin_ll(reads, u, omega_, log_pi_, phi_, cn_obs, rep_obs):
+        if spec.step1:
+            return _observed_bin_loglik(spec, reads, u, omega_, log_pi_, phi_,
+                                        cn_obs, rep_obs, lamb, log_lamb,
+                                        log1m_lamb)
+        return _enum_bin_loglik(spec, reads, u, omega_, log_pi_, phi_, lamb,
+                                log_lamb, log1m_lamb)
+
+    if spec.cell_chunk is None:
+        ll = bin_ll(batch.reads, c["u"], omega, log_pi, phi,
+                    batch.cn_obs, batch.rep_obs)
+        lp += jnp.sum(ll * mask[:, None])
+    else:
+        # chunk the cells axis through lax.map so only a
+        # (chunk, loci, P, 2) slab of the enumeration tensor is live at once
+        ch = spec.cell_chunk
+        assert num_cells % ch == 0, (
+            f"cells={num_cells} not divisible by cell_chunk={ch}; pad first")
+        nch = num_cells // ch
+
+        def _r(x):
+            return None if x is None else x.reshape((nch, ch) + x.shape[1:])
+
+        chunks = (_r(batch.reads), _r(c["u"]), _r(omega), _r(log_pi), _r(phi),
+                  _r(batch.cn_obs), _r(batch.rep_obs), _r(mask))
+
+        def body(args):
+            reads, u, omega_, log_pi_, phi_, cn_obs, rep_obs, m = args
+            return jnp.sum(bin_ll(reads, u, omega_, log_pi_, phi_, cn_obs,
+                                  rep_obs) * m[:, None])
+
+        present = [x for x in chunks if x is not None]
+        idxs = [i for i, x in enumerate(chunks) if x is not None]
+
+        def body_packed(packed):
+            full = [None] * len(chunks)
+            for i, x in zip(idxs, packed):
+                full[i] = x
+            return body(tuple(full))
+
+        lp += jnp.sum(jax.lax.map(body_packed, tuple(present)))
+
+    return lp
+
+
+def pert_loss(spec: PertModelSpec, params: dict, fixed: dict,
+              batch: PertBatch) -> jnp.ndarray:
+    """SVI loss = -ELBO = -log_joint (delta guide; matches the sign and
+    scale of the reference's ``svi.step`` losses, pert_model.py:742-758)."""
+    return -log_joint(spec, params, fixed, batch)
+
+
+# ---------------------------------------------------------------------------
+# discrete decode (infer_discrete, temperature=0)
+# ---------------------------------------------------------------------------
+
+def decode_discrete(spec: PertModelSpec, params: dict, fixed: dict,
+                    batch: PertBatch):
+    """MAP cn/rep per bin + marginal replication probability.
+
+    Equivalent to ``infer_discrete(temperature=0)`` on the trained model
+    (reference: pert_model.py:824-827): because the model has no cross-bin
+    coupling given the global latents (the HMM transition matrix is dead
+    code, reference: pert_model.py:260-269), the joint MAP factorises into
+    an independent argmax over the (P, 2) logits of each bin.
+
+    Returns (cn_map, rep_map, p_rep) each (cells, loci); p_rep is the
+    posterior marginal P(rep=1 | reads) — a capability the reference's
+    temperature-0 decode does not expose.
+    """
+    c = constrained(spec, params, fixed)
+    lamb, log_lamb, log1m_lamb = _nb_pieces(c)
+    log_pi = jnp.log(c["pi"])
+    phi = _phi(c, batch.reads.shape[1])
+    omega = gc_rate(c["betas"], batch.gamma_feats)
+
+    P = spec.P
+    joint = _joint_logits(P, batch.reads, c["u"], omega, log_pi, phi, lamb,
+                          log_lamb, log1m_lamb)                  # (c, l, P, 2)
+
+    flat = joint.reshape(joint.shape[:-2] + (P * 2,))
+    best = jnp.argmax(flat, axis=-1)
+    cn_map = (best // 2).astype(jnp.int32)
+    rep_map = (best % 2).astype(jnp.int32)
+
+    norm = logsumexp(flat, axis=-1)
+    p_rep = jnp.exp(logsumexp(joint[..., 1], axis=-1) - norm)
+    return cn_map, rep_map, p_rep

@@ -1,0 +1,172 @@
+"""CN-prior (eta) concentration builders, vectorised.
+
+The reference builds its (loci, cells, P) Dirichlet concentration tensors
+with Python triple loops (reference: pert_model.py:272-282 ``build_cn_prior``,
+:285-296 ``build_clone_cn_prior``, :299-361 ``build_composite_cn_prior``)
+and O(cells^2) per-cell Pearson scans.  Here each prior is a one-hot
+scatter over the state axis, and the S-cell x G1-cell correlation matrix
+is a single matmul (:func:`..ops.stats.pearson_matrix`).
+
+Layout: (cells, loci, P) to match the model's batch axis.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from scdna_replication_tools_tpu.ops.stats import mode_int, pearson_matrix
+
+
+def one_hot_states(states: np.ndarray, P: int) -> np.ndarray:
+    """(cells, loci) integer states -> (cells, loci, P) one-hot float32."""
+    s = np.clip(states.astype(np.int64), 0, P - 1)
+    return np.eye(P, dtype=np.float32)[s]
+
+
+def cn_prior_from_states(states: np.ndarray, P: int, weight: float) -> np.ndarray:
+    """etas = ones, with ``weight`` at each bin's given state.
+
+    Mirrors ``build_cn_prior`` (reference: pert_model.py:272-282).
+    Used directly for the 'hmmcopy' and 'diploid' methods.
+    """
+    oh = one_hot_states(states, P)
+    return 1.0 + (weight - 1.0) * oh
+
+
+def uniform_prior(num_cells: int, num_loci: int, P: int) -> np.ndarray:
+    """Uniform fallback etas = 1/P (reference: pert_model.py:713-716)."""
+    return np.full((num_cells, num_loci, P), 1.0 / P, np.float32)
+
+
+def cell_ploidies(states: np.ndarray) -> np.ndarray:
+    """Per-cell ploidy = modal CN state (reference:
+    compute_consensus_clone_profiles.py:30-39)."""
+    return np.array([mode_int(row) for row in states], dtype=np.float32)
+
+
+def majority_ploidy_mask(ploidies: np.ndarray, clone_idx: np.ndarray
+                         ) -> np.ndarray:
+    """Keep only cells whose ploidy is the majority ploidy of their clone.
+
+    Mirrors ``filter_ploidies`` (reference:
+    compute_consensus_clone_profiles.py:17-27).
+    """
+    keep = np.zeros(len(ploidies), dtype=bool)
+    for c in np.unique(clone_idx):
+        in_clone = clone_idx == c
+        vals, counts = np.unique(ploidies[in_clone], return_counts=True)
+        keep_ploidy = vals[np.argmax(counts)]
+        keep |= in_clone & (ploidies == keep_ploidy)
+    return keep
+
+
+def consensus_clone_profiles(
+    values: np.ndarray,
+    clone_idx: np.ndarray,
+    num_clones: int,
+    states: Optional[np.ndarray] = None,
+    aggfunc=np.median,
+) -> np.ndarray:
+    """(num_clones, loci) per-clone aggregate (median) profile.
+
+    Dense equivalent of ``compute_consensus_clone_profiles`` (reference:
+    compute_consensus_clone_profiles.py:42-88) including the majority-
+    ploidy cell filter when ``states`` is provided.
+    """
+    if states is not None:
+        keep = majority_ploidy_mask(cell_ploidies(states), clone_idx)
+    else:
+        keep = np.ones(len(clone_idx), dtype=bool)
+    out = np.zeros((num_clones, values.shape[1]), np.float32)
+    for c in range(num_clones):
+        sel = keep & (clone_idx == c)
+        if not sel.any():          # fall back to all cells of the clone
+            sel = clone_idx == c
+        out[c] = aggfunc(values[sel], axis=0)
+    return out
+
+
+def clone_cn_prior(
+    clone_idx: np.ndarray,
+    clone_cn_profiles: np.ndarray,
+    P: int,
+    weight: float,
+) -> np.ndarray:
+    """Per-cell etas from the consensus profile of the cell's clone.
+
+    Mirrors ``build_clone_cn_prior`` (reference: pert_model.py:285-296):
+    the clone's consensus profile (int-truncated) gets ``weight``.
+    """
+    profiles = clone_cn_profiles.astype(np.int64).astype(np.float32)
+    states = profiles[clone_idx]                  # (cells, loci)
+    return cn_prior_from_states(states, P, weight)
+
+
+def composite_cn_prior(
+    s_assign: np.ndarray,
+    s_clone_idx: np.ndarray,
+    g1_assign: np.ndarray,
+    g1_states: np.ndarray,
+    g1_clone_idx: np.ndarray,
+    clone_cn_profiles: np.ndarray,
+    P: int,
+    J: int = 5,
+    weight: float = 1e5,
+) -> np.ndarray:
+    """Composite clone + top-J-matching-G1-cell prior.
+
+    Vectorised ``build_composite_cn_prior`` (reference:
+    pert_model.py:299-361):
+
+    * J is clamped to the smallest clone's G1 cell count (:307-310);
+    * G1 cells outside their clone's majority ploidy are excluded
+      (:312-317);
+    * each S cell adds ``weight*J*2`` concentration at its clone's
+      consensus state and ``weight*(J-j)`` at the state of its j-th
+      best-Pearson-correlated G1 cell (same clone), j=0..J-1 (:349-359);
+    * correlations use the assignment column profiles (:335-337), here as
+      one (S, G1) matmul.
+
+    ``s_assign``/``g1_assign`` are the (cells, loci) profiles of the
+    assignment column (input_col); ``g1_states`` the HMMcopy states.
+    """
+    num_cells, num_loci = s_assign.shape
+
+    # clamp J to the smallest clone size (pre-ploidy-filter, like the ref)
+    sizes = np.bincount(g1_clone_idx, minlength=clone_cn_profiles.shape[0])
+    sizes = sizes[sizes > 0]
+    J = int(min(J, sizes.min()))
+
+    keep = majority_ploidy_mask(cell_ploidies(g1_states), g1_clone_idx)
+    # also clamp J to the smallest *filtered* clone size so top-J indexing
+    # below is always valid (the reference would raise here)
+    filt_sizes = np.array([
+        max(int(((g1_clone_idx == c) & keep).sum()), 1)
+        for c in np.unique(g1_clone_idx)
+    ])
+    J = int(min(J, filt_sizes.min()))
+
+    corr = np.asarray(pearson_matrix(s_assign, g1_assign))   # (S, G1)
+    same_clone = s_clone_idx[:, None] == g1_clone_idx[None, :]
+    valid = same_clone & keep[None, :]
+    corr = np.where(valid, corr, -np.inf)
+
+    # top-J G1 cells per S cell by correlation
+    order = np.argsort(-corr, axis=1)[:, :J]                 # (S, J)
+
+    etas = np.ones((num_cells, num_loci, P), np.float32)
+
+    # clone consensus contribution: weight * J * 2
+    profiles = clone_cn_profiles.astype(np.int64).astype(np.float32)
+    clone_states = profiles[s_clone_idx]                     # (S, loci)
+    etas += (weight * J * 2.0) * one_hot_states(clone_states, P)
+
+    # top-J G1-cell contributions: weight * (J - j)
+    g1_state_int = np.clip(g1_states.astype(np.int64), 0, P - 1)
+    for j in range(J):
+        sel_states = g1_state_int[order[:, j]]               # (S, loci)
+        etas += (weight * (J - j)) * one_hot_states(sel_states, P)
+
+    return etas

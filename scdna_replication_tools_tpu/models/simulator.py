@@ -1,0 +1,261 @@
+"""Generative PERT simulator — prior-predictive sampling in JAX.
+
+Re-expression of ``pert_simulator`` (reference: pert_simulator.py:38-124
+``model_s``, :128-174 ``model_g1``, :201-282 cell samplers, :285-418 pandas
+driver).  All cells of a clone are sampled in one vectorised draw; the
+NegativeBinomial is sampled as its Gamma-Poisson mixture so everything runs
+as batched jax.random ops and scales to 10k+ cells on device.
+
+Simulator-specific semantics preserved from the reference:
+
+* ``tau ~ Beta(1, 1)`` (uniform; reference: pert_simulator.py:77 — note the
+  inference model uses Beta(1.5, 1.5) instead);
+* ``u`` is *conditioned* to the scalar ``u_guess`` for every cell
+  (reference: pert_simulator.py:219-227: 'expose_u' in the condition dict);
+* per-cell GC betas are sampled around the given coefficients with the
+  logspace(1 -> 10^-K) prior stds (the 'expose_beta_stds' param is not
+  conditioned; reference: pert_simulator.py:53-54, 83);
+* phi is NOT clamped in the simulator (reference: pert_simulator.py:101);
+* raw NB reads are per-cell normalised to ``num_reads`` total and
+  int-truncated (reference: pert_simulator.py:246-248).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from scdna_replication_tools_tpu.ops.gc import gc_features, gc_rate
+
+
+def convert_rt_units(rt: np.ndarray) -> np.ndarray:
+    """Map an RT profile to [0, 1] with *largest* values earliest -> 0.
+
+    Mirrors ``convert_rt_units`` (reference: pert_simulator.py:177-179).
+    """
+    rt = np.asarray(rt, np.float32)
+    return 1.0 - (rt - rt.min()) / (rt.max() - rt.min())
+
+
+def _sample_nb(key, delta, lamb):
+    """NegativeBinomial(total_count=delta, probs=lamb) via Gamma-Poisson.
+
+    reads ~ Poisson(g), g ~ Gamma(shape=delta, rate=(1-lamb)/lamb)
+    => mean = delta * lamb / (1 - lamb), matching torch's NB.
+    """
+    k1, k2 = jax.random.split(key)
+    g = jax.random.gamma(k1, delta) * (lamb / (1.0 - lamb))
+    return jax.random.poisson(k2, g).astype(jnp.float32)
+
+
+def simulate_s_reads(
+    key: jax.Array,
+    cn: jnp.ndarray,           # (cells, loci) true somatic CN
+    gammas: jnp.ndarray,       # (loci,) GC content
+    rho: jnp.ndarray,          # (loci,) RT profile already in [0,1]
+    libs: jnp.ndarray,         # (cells,) int library index
+    num_reads: float,
+    lamb: float,
+    betas: Sequence[float],    # GC polynomial, descending powers
+    a: float,
+    num_libraries: int = 1,
+    tau: Optional[jnp.ndarray] = None,
+):
+    """Sample S-phase read counts; returns a dict of device arrays.
+
+    Vectorised equivalent of ``simulate_s_cells``
+    (reference: pert_simulator.py:201-249).
+    """
+    cn = jnp.asarray(cn, jnp.float32)
+    num_cells, num_loci = cn.shape
+    betas = jnp.asarray(betas, jnp.float32)
+    K = betas.shape[0] - 1
+
+    u_guess = float(num_reads) / (1.5 * num_loci * jnp.mean(cn))  # :209
+
+    k_tau, k_betas, k_rep, k_reads = jax.random.split(key, 4)
+    if tau is None:
+        tau = jax.random.uniform(k_tau, (num_cells,))             # Beta(1,1)
+
+    beta_means = jnp.tile(betas[None, :], (num_libraries, 1))
+    beta_stds = jnp.tile(
+        jnp.logspace(0.0, -K, K + 1, dtype=jnp.float32)[None, :],
+        (num_libraries, 1))
+    cell_betas = beta_means[libs] + beta_stds[libs] * \
+        jax.random.normal(k_betas, (num_cells, K + 1))            # :83
+
+    t_diff = tau[:, None] - rho[None, :]
+    phi = jax.nn.sigmoid(a * t_diff)                              # :101
+    rep = jax.random.bernoulli(k_rep, phi).astype(jnp.float32)    # :104
+
+    chi = cn * (1.0 + rep)                                        # :107
+    feats = gc_features(jnp.asarray(gammas, jnp.float32), K)
+    omega = gc_rate(cell_betas, feats)                            # :110-111
+    theta = u_guess * chi * omega                                 # :114
+    delta = jnp.maximum(theta * (1.0 - lamb) / lamb, 1.0)         # :118-122
+    reads = _sample_nb(k_reads, delta, lamb)                      # :124
+
+    reads_norm = jnp.floor(
+        reads / jnp.sum(reads, axis=1, keepdims=True) * num_reads)  # :246-248
+    return dict(reads_norm=reads_norm, reads=reads, rep=rep, p_rep=phi,
+                tau=tau, total_cn=chi, betas=cell_betas)
+
+
+def simulate_g_reads(
+    key: jax.Array,
+    cn: jnp.ndarray,
+    gammas: jnp.ndarray,
+    libs: jnp.ndarray,
+    num_reads: float,
+    lamb: float,
+    betas: Sequence[float],
+    num_libraries: int = 1,
+):
+    """Sample G1/2-phase read counts (no replication process).
+
+    Vectorised ``simulate_g_cells`` (reference: pert_simulator.py:252-282);
+    ``u_guess`` uses the 1.0x ploidy factor (:259).
+    """
+    cn = jnp.asarray(cn, jnp.float32)
+    num_cells, num_loci = cn.shape
+    betas = jnp.asarray(betas, jnp.float32)
+    K = betas.shape[0] - 1
+
+    u_guess = float(num_reads) / (1.0 * num_loci * jnp.mean(cn))
+
+    k_betas, k_reads = jax.random.split(key)
+    beta_means = jnp.tile(betas[None, :], (num_libraries, 1))
+    beta_stds = jnp.tile(
+        jnp.logspace(0.0, -K, K + 1, dtype=jnp.float32)[None, :],
+        (num_libraries, 1))
+    cell_betas = beta_means[libs] + beta_stds[libs] * \
+        jax.random.normal(k_betas, (num_cells, K + 1))
+
+    feats = gc_features(jnp.asarray(gammas, jnp.float32), K)
+    omega = gc_rate(cell_betas, feats)
+    theta = u_guess * cn * omega                                  # :162
+    delta = jnp.maximum(theta * (1.0 - lamb) / lamb, 1.0)
+    reads = _sample_nb(k_reads, delta, lamb)
+
+    reads_norm = jnp.floor(
+        reads / jnp.sum(reads, axis=1, keepdims=True) * num_reads)
+    return dict(reads_norm=reads_norm, reads=reads, betas=cell_betas)
+
+
+# ---------------------------------------------------------------------------
+# pandas driver (reference API parity)
+# ---------------------------------------------------------------------------
+
+def _libs_index(df: pd.DataFrame, cell_col="cell_id", library_col="library_id"):
+    libs = df[[cell_col, library_col]].drop_duplicates(cell_col)
+    ids = list(libs[library_col].unique())
+    mapping = {lib: i for i, lib in enumerate(ids)}
+    return libs.set_index(cell_col)[library_col].map(mapping), len(ids)
+
+
+def pert_simulator(
+    df_s: pd.DataFrame,
+    df_g: pd.DataFrame,
+    num_reads: int,
+    rt_cols: List[str],
+    clones: List[str],
+    lamb: float,
+    betas: Sequence[float],
+    a: float,
+    gc_col: str = "gc",
+    input_cn_col: str = "true_somatic_cn",
+    seed: int = 0,
+) -> Tuple[pd.DataFrame, pd.DataFrame]:
+    """Simulate S- and G1-phase read counts for cells with known CN.
+
+    pandas-in/pandas-out parity with ``pert_simulator``
+    (reference: pert_simulator.py:285-418): one RT column per clone;
+    outputs gain true_reads_norm, true_reads_raw, true_rep, true_p_rep,
+    true_t and true_total_cn columns.
+    """
+    df_s = df_s.copy()
+    df_g = df_g.copy()
+    df_s["chr"] = df_s["chr"].astype(str)
+    df_g["chr"] = df_g["chr"].astype(str)
+    assert len(rt_cols) == len(clones)
+
+    key = jax.random.PRNGKey(seed)
+
+    s_out = []
+    for rt_col, clone_id in zip(rt_cols, clones):
+        clone_df = df_s[df_s["clone_id"].astype(str) == str(clone_id)]
+        libs_map, L = _libs_index(clone_df)
+
+        cn_mat = clone_df.pivot_table(index="cell_id",
+                                      columns=["chr", "start"],
+                                      values=input_cn_col)
+        loci_df = clone_df[["chr", "start", gc_col, rt_col]] \
+            .drop_duplicates(["chr", "start"]).set_index(["chr", "start"])
+        loci_df = loci_df.reindex(cn_mat.columns)
+        gammas = loci_df[gc_col].to_numpy(np.float32)
+        rho = convert_rt_units(loci_df[rt_col].to_numpy())
+
+        libs = libs_map.reindex(cn_mat.index).to_numpy(np.int32)
+
+        key, sub = jax.random.split(key)
+        sim = simulate_s_reads(sub, cn_mat.to_numpy(np.float32), gammas,
+                               jnp.asarray(rho), jnp.asarray(libs),
+                               num_reads, lamb, betas, a, num_libraries=L)
+
+        def _melt(arr, name):
+            m = pd.DataFrame(np.asarray(arr), index=cn_mat.index,
+                             columns=cn_mat.columns)
+            m = m.T.melt(ignore_index=False, value_name=name).reset_index()
+            m["chr"] = m["chr"].astype(str)
+            return m
+
+        merged = clone_df
+        merged = pd.merge(merged, _melt(sim["reads_norm"], "true_reads_norm"))
+        merged = pd.merge(merged, _melt(sim["reads"], "true_reads_raw"))
+        merged = pd.merge(merged, _melt(sim["rep"], "true_rep"))
+        merged = pd.merge(merged, _melt(sim["p_rep"], "true_p_rep"))
+        tau_df = pd.DataFrame({
+            "cell_id": cn_mat.index,
+            "true_t": np.asarray(sim["tau"]),
+        })
+        merged = pd.merge(merged, tau_df, on="cell_id")
+        s_out.append(merged)
+
+    df_s = pd.concat(s_out, ignore_index=True)
+
+    libs_map, L = _libs_index(df_g)
+    cn_mat = df_g.pivot_table(index="cell_id", columns=["chr", "start"],
+                              values=input_cn_col)
+    loci_df = df_g[["chr", "start", gc_col]] \
+        .drop_duplicates(["chr", "start"]).set_index(["chr", "start"])
+    loci_df = loci_df.reindex(cn_mat.columns)
+    gammas = loci_df[gc_col].to_numpy(np.float32)
+    libs = libs_map.reindex(cn_mat.index).to_numpy(np.int32)
+
+    key, sub = jax.random.split(key)
+    sim_g = simulate_g_reads(sub, cn_mat.to_numpy(np.float32), gammas,
+                             jnp.asarray(libs), num_reads, lamb, betas,
+                             num_libraries=L)
+
+    def _melt_g(arr, name):
+        m = pd.DataFrame(np.asarray(arr), index=cn_mat.index,
+                         columns=cn_mat.columns)
+        m = m.T.melt(ignore_index=False, value_name=name).reset_index()
+        m["chr"] = m["chr"].astype(str)
+        return m
+
+    df_g = pd.merge(df_g, _melt_g(sim_g["reads_norm"], "true_reads_norm"))
+    df_g = pd.merge(df_g, _melt_g(sim_g["reads"], "true_reads_raw"))
+    df_g["true_t"] = 0.0
+    df_g["true_rep"] = 0.0
+    df_g["true_p_rep"] = 0.0
+
+    # true total CN = somatic CN * (1 + rep) (reference: pert_simulator.py:414-416)
+    df_s["true_total_cn"] = df_s[input_cn_col] * (df_s["true_rep"] + 1)
+    df_g["true_total_cn"] = df_g[input_cn_col] * (df_g["true_rep"] + 1)
+
+    return df_s, df_g

@@ -1,0 +1,3 @@
+from scdna_replication_tools_tpu.ops import dists, gc, transforms
+
+__all__ = ["dists", "gc", "transforms"]

@@ -1,0 +1,74 @@
+"""Log-density kernels for the PERT graphical model.
+
+These are the only distributions the reference model touches
+(reference: pert_model.py:541-646): NegativeBinomial (observation),
+Gamma (a), Normal (u, betas, beta_means), Beta (rho, tau), Dirichlet (pi),
+Categorical (cn), Bernoulli (rep).  All are written as elementwise jnp
+functions so XLA fuses them straight into the enumeration tensor without
+any distribution-object overhead.
+
+Parameterisations follow torch.distributions so fitted values are directly
+comparable with the reference:
+
+* ``NegativeBinomial(total_count=delta, probs=lamb)`` — number of successes
+  before ``delta`` failures; mean = delta * lamb / (1 - lamb).
+* ``Gamma(concentration, rate)``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.scipy.special import gammaln, xlogy
+
+
+def nb_log_prob(k, total_count, log_lamb, log1m_lamb):
+    """NegativeBinomial log pmf with precomputed log(λ) and log(1-λ).
+
+    log NB(k | δ, λ) = lgamma(k+δ) - lgamma(δ) - lgamma(k+1)
+                       + δ·log(1-λ) + k·log(λ)
+    """
+    return (
+        gammaln(k + total_count)
+        - gammaln(total_count)
+        - gammaln(k + 1.0)
+        + total_count * log1m_lamb
+        + k * log_lamb
+    )
+
+
+def gamma_log_prob(x, concentration, rate):
+    return (
+        concentration * jnp.log(rate)
+        - gammaln(concentration)
+        + (concentration - 1.0) * jnp.log(x)
+        - rate * x
+    )
+
+
+def normal_log_prob(x, loc, scale):
+    z = (x - loc) / scale
+    return -0.5 * z * z - jnp.log(scale) - 0.5 * jnp.log(2.0 * jnp.pi)
+
+
+def beta_log_prob(x, alpha, beta):
+    return (
+        xlogy(alpha - 1.0, x)
+        + xlogy(beta - 1.0, 1.0 - x)
+        + gammaln(alpha + beta)
+        - gammaln(alpha)
+        - gammaln(beta)
+    )
+
+
+def dirichlet_log_prob(p, concentration, axis=-1):
+    """Dirichlet log pdf along ``axis`` (the simplex axis)."""
+    return (
+        jnp.sum(xlogy(concentration - 1.0, p), axis=axis)
+        + gammaln(jnp.sum(concentration, axis=axis))
+        - jnp.sum(gammaln(concentration), axis=axis)
+    )
+
+
+def bernoulli_log_prob(x, p):
+    """Bernoulli log pmf for x in {0., 1.} with probability p."""
+    return xlogy(x, p) + xlogy(1.0 - x, 1.0 - p)

@@ -1,0 +1,29 @@
+"""GC-bias feature construction.
+
+Mirrors ``make_gc_features`` (reference: pert_model.py:460-463,
+pert_simulator.py:32-35): a per-locus polynomial feature matrix
+[x^K, x^(K-1), ..., x, 1] — note the reference stores features in
+*descending* power order, which matters because the per-library prior
+stds are logspace(1 → 10^-K) over the same ordering
+(reference: pert_model.py:561-562).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def gc_features(gammas: jnp.ndarray, K: int) -> jnp.ndarray:
+    """(num_loci,) GC fractions -> (num_loci, K+1) features, powers K..0."""
+    powers = jnp.arange(K, -1, -1, dtype=gammas.dtype)
+    return gammas[:, None] ** powers[None, :]
+
+
+def gc_rate(betas: jnp.ndarray, features: jnp.ndarray) -> jnp.ndarray:
+    """omega[n, i] = exp(sum_k betas[n, k] * features[i, k]).
+
+    The per-(cell, locus) GC rate (reference: pert_model.py:632-633) as a
+    single (cells, K+1) x (K+1, loci) matmul feeding the MXU, instead of
+    the reference's broadcast-multiply-reduce.
+    """
+    return jnp.exp(betas @ features.T)

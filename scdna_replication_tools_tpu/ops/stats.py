@@ -1,0 +1,277 @@
+"""Batched statistical primitives replacing the reference's per-cell loops.
+
+Every op here is written to run over **all cells at once** as dense array
+ops (vmap/matmul/scan) instead of the reference's Python ``for cell``
+loops:
+
+* :func:`pearson_matrix` — an (A, B) Pearson correlation matrix as one
+  matmul on standardised profiles.  Subsumes ``compute_cell_corrs``
+  (reference: normalize_by_cell.py:148-180) and the per-cell loops of
+  ``assign_s_to_clones`` (reference: assign_s_to_clones.py:68-77).
+* :func:`gmm2_em` — 2-component 1-D Gaussian mixture EM, vmapped over
+  cells (reference uses sklearn GaussianMixture per cell,
+  pert_model.py:370-371, binarize_rt_profiles.py:46-48).
+* :func:`manhattan_binarize` — the Dileep & Gilbert threshold scan
+  (reference: pert_model.py:364-423) as a lax.scan over 100 thresholds for
+  all cells simultaneously.
+* :func:`guess_times` — per-cell S-phase time initialisation
+  (reference: pert_model.py:426-457).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# correlation
+# ---------------------------------------------------------------------------
+
+def _standardize_rows(x: jnp.ndarray, eps: float = 1e-12) -> jnp.ndarray:
+    mu = jnp.mean(x, axis=1, keepdims=True)
+    sd = jnp.std(x, axis=1, keepdims=True)
+    return (x - mu) / (sd + eps)
+
+
+def pearson_matrix(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Pearson correlation between every row of ``a`` and every row of ``b``.
+
+    a: (A, L), b: (B, L) -> (A, B).  One (A, L) x (L, B) matmul on
+    standardised rows — MXU-friendly — versus the reference's
+    O(A*B) scipy ``pearsonr`` calls.
+    """
+    az = _standardize_rows(jnp.asarray(a, jnp.float32))
+    bz = _standardize_rows(jnp.asarray(b, jnp.float32))
+    return az @ bz.T / a.shape[1]
+
+
+def masked_pearson_matrix(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """NaN-aware Pearson matrix between rows of ``a`` (A, L) and ``b`` (B, L).
+
+    Each (i, j) correlation uses only loci observed in both rows —
+    matching the reference's per-pair merge-then-dropna behaviour
+    (reference: assign_s_to_clones.py:30-44) — but computed with five
+    matmuls instead of A*B scipy calls.
+    """
+    a = np.asarray(a, np.float64)
+    b = np.asarray(b, np.float64)
+    ma = np.isfinite(a).astype(np.float64)
+    mb = np.isfinite(b).astype(np.float64)
+    a0 = np.where(ma > 0, a, 0.0)
+    b0 = np.where(mb > 0, b, 0.0)
+
+    n = ma @ mb.T
+    sx = a0 @ mb.T
+    sy = ma @ b0.T
+    sxx = (a0 * a0) @ mb.T
+    syy = ma @ (b0 * b0).T
+    sxy = a0 @ b0.T
+
+    cov = n * sxy - sx * sy
+    var_x = n * sxx - sx * sx
+    var_y = n * syy - sy * sy
+    denom = np.sqrt(np.clip(var_x, 0, None) * np.clip(var_y, 0, None))
+    with np.errstate(invalid="ignore", divide="ignore"):
+        r = cov / denom
+    return np.where(denom > 0, r, np.nan)
+
+
+# ---------------------------------------------------------------------------
+# skewness (scipy.stats.skew, bias=True)
+# ---------------------------------------------------------------------------
+
+def skew(x: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    mu = jnp.mean(x, axis=axis, keepdims=True)
+    m2 = jnp.mean((x - mu) ** 2, axis=axis)
+    m3 = jnp.mean((x - mu) ** 3, axis=axis)
+    return m3 / jnp.clip(m2, 1e-30, None) ** 1.5
+
+
+# ---------------------------------------------------------------------------
+# 2-component 1-D Gaussian mixture via EM
+# ---------------------------------------------------------------------------
+
+def gmm2_em(x: jnp.ndarray, num_iters: int = 60, eps: float = 1e-6
+            ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Fit a 2-component 1-D GMM to each row of ``x`` (cells, loci).
+
+    Returns (means (cells, 2), variances (cells, 2), weights (cells, 2)).
+    Initialisation splits at the median (lower/upper half means), then runs
+    a fixed number of EM iterations — fixed trip count keeps the loop XLA-
+    friendly (vs sklearn's tol-based loop, binarize_rt_profiles.py:47).
+    """
+    x = jnp.asarray(x, jnp.float32)
+    lo = jnp.percentile(x, 25.0, axis=1)
+    hi = jnp.percentile(x, 75.0, axis=1)
+    mu = jnp.stack([lo, hi], axis=1)                      # (cells, 2)
+    var = jnp.var(x, axis=1, keepdims=True) * jnp.ones((1, 2)) + eps
+    w = jnp.full(mu.shape, 0.5)
+
+    def em_step(carry, _):
+        mu, var, w = carry
+        # E-step: responsibilities (cells, loci, 2)
+        diff = x[:, :, None] - mu[:, None, :]
+        log_p = (
+            -0.5 * diff * diff / var[:, None, :]
+            - 0.5 * jnp.log(2.0 * jnp.pi * var[:, None, :])
+            + jnp.log(w[:, None, :] + eps)
+        )
+        r = jax.nn.softmax(log_p, axis=2)
+        # M-step
+        nk = jnp.sum(r, axis=1) + eps                     # (cells, 2)
+        mu = jnp.sum(r * x[:, :, None], axis=1) / nk
+        diff = x[:, :, None] - mu[:, None, :]
+        var = jnp.sum(r * diff * diff, axis=1) / nk + eps
+        w = nk / x.shape[1]
+        return (mu, var, w), None
+
+    (mu, var, w), _ = jax.lax.scan(em_step, (mu, var, w), None,
+                                   length=num_iters)
+    return mu, var, w
+
+
+def gmm2_log_likelihood(x, mu, var, w, eps=1e-6):
+    """Mean per-point log-likelihood of each row under its 2-GMM."""
+    diff = x[:, :, None] - mu[:, None, :]
+    log_p = (
+        -0.5 * diff * diff / var[:, None, :]
+        - 0.5 * jnp.log(2.0 * jnp.pi * var[:, None, :])
+        + jnp.log(w[:, None, :] + eps)
+    )
+    return jnp.mean(jax.scipy.special.logsumexp(log_p, axis=2), axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Manhattan binarisation
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("num_thresh", "scale_input",
+                                             "thresh_from_binaries"))
+def manhattan_binarize(
+    x: jnp.ndarray,
+    num_thresh: int = 100,
+    mean_gap_thresh: float = 0.7,
+    early_s_skew_thresh: float = 0.2,
+    late_s_skew_thresh: float = -0.2,
+    scale_input: bool = True,
+    thresh_from_binaries: bool = True,
+):
+    """Binarise each cell's profile at the Manhattan-optimal threshold.
+
+    Vectorised port of ``manhattan_binarization``
+    (reference: pert_model.py:364-423) and of the per-cell scan in
+    ``binarize_profiles`` (reference: binarize_rt_profiles.py:44-117):
+
+    * 2-GMM means define the binary levels; when the means are closer than
+      ``mean_gap_thresh`` the levels fall back to skew-dependent
+      percentiles (reference: pert_model.py:387-400);
+    * 100 candidate thresholds are scanned for the minimum L1 distance
+      between the profile and its binarisation.  ``thresh_from_binaries``
+      selects the reference's two threshold grids: linspace(b0, b1)
+      (pert_model.py:404) vs linspace(-3, 3)
+      (binarize_rt_profiles.py:89).
+
+    Returns (rt_state (cells, loci) int32, frac_rt (cells,), best_thresh
+    (cells,), gmm (means, vars, weights)).
+    """
+    x = jnp.asarray(x, jnp.float32)
+    if scale_input:
+        x = _standardize_rows(x)
+
+    mu, var, w = gmm2_em(x)
+    mean_lo = jnp.min(mu, axis=1)
+    mean_hi = jnp.max(mu, axis=1)
+    mean_gap = mean_hi - mean_lo
+
+    cell_skew = skew(x, axis=1)
+    p5, p25, p50, p75, p95 = [
+        jnp.percentile(x, q, axis=1) for q in (5.0, 25.0, 50.0, 75.0, 95.0)
+    ]
+    early = cell_skew > early_s_skew_thresh
+    late = cell_skew < late_s_skew_thresh
+    fb_b0 = jnp.where(early, p50, jnp.where(late, p5, p25))
+    fb_b1 = jnp.where(early, p95, jnp.where(late, p50, p75))
+
+    close = mean_gap < mean_gap_thresh
+    b0 = jnp.where(close, fb_b0, mean_lo)
+    b1 = jnp.where(close, fb_b1, mean_hi)
+
+    if thresh_from_binaries:
+        # per-cell grids linspace(b0, b1, T) (pert_model.py:404)
+        frac = jnp.linspace(0.0, 1.0, num_thresh)
+        threshs = b0[:, None] + (b1 - b0)[:, None] * frac[None, :]
+    else:
+        threshs = jnp.broadcast_to(
+            jnp.linspace(-3.0, 3.0, num_thresh)[None, :],
+            (x.shape[0], num_thresh))
+
+    def scan_step(best, t):
+        # t: (cells,) threshold; best: (best_dist, best_t)
+        best_dist, best_t = best
+        bin_x = jnp.where(x > t[:, None], b1[:, None], b0[:, None])
+        dist = jnp.sum(jnp.abs(x - bin_x), axis=1)
+        better = dist < best_dist
+        return (jnp.where(better, dist, best_dist),
+                jnp.where(better, t, best_t)), dist
+
+    init = (jnp.full((x.shape[0],), jnp.inf), jnp.zeros((x.shape[0],)))
+    (best_dist, best_t), all_dists = jax.lax.scan(scan_step, init, threshs.T)
+
+    rt_state = (x > best_t[:, None]).astype(jnp.int32)
+    frac_rt = jnp.mean(rt_state.astype(jnp.float32), axis=1)
+    return rt_state, frac_rt, best_t, (mu, var, w), all_dists.T
+
+
+def guess_times(reads: jnp.ndarray, etas: jnp.ndarray, upsilon: float = 6.0):
+    """Initial guess of each cell's time in S-phase.
+
+    Vectorised ``guess_times`` (reference: pert_model.py:426-457): read
+    counts are normalised by the CN-prior argmax state (0.5 where the
+    prior says homozygous deletion) and Manhattan-binarised; the
+    replicated fraction seeds ``t_init`` and a Beta(alpha, upsilon-alpha)
+    prior.
+    """
+    cn_states = jnp.argmax(etas, axis=-1).astype(jnp.float32)
+    denom = jnp.where(cn_states > 0.0, cn_states, 0.5)
+    reads_norm = jnp.asarray(reads, jnp.float32) / denom
+    _, frac_rt, _, _, _ = manhattan_binarize(reads_norm)
+    t_init = frac_rt
+    t_alpha = t_init * upsilon
+    t_beta = upsilon - t_alpha
+    return t_init, t_alpha, t_beta
+
+
+# ---------------------------------------------------------------------------
+# misc small ops shared by pipeline stages
+# ---------------------------------------------------------------------------
+
+def autocorrelation_mean(x: np.ndarray, min_lag: int = 10, max_lag: int = 50
+                         ) -> float:
+    """Mean of the ACF over lags [min_lag, max_lag].
+
+    Replaces ``statsmodels.tsa.acf`` in ``autocorr``
+    (reference: predict_cycle_phase.py:23-25): ACF computed with the
+    standard biased estimator (denominator n, lag-0 variance).
+    """
+    x = np.asarray(x, np.float64)
+    n = x.size
+    x = x - x.mean()
+    denom = np.dot(x, x)
+    if denom == 0 or n <= max_lag:
+        max_lag = min(max_lag, n - 1)
+    acf = np.empty(max_lag + 1)
+    acf[0] = 1.0
+    for k in range(1, max_lag + 1):
+        acf[k] = np.dot(x[:-k], x[k:]) / denom if denom > 0 else 0.0
+    return float(np.mean(acf[min_lag - 1:]))
+
+
+def mode_int(values: np.ndarray) -> float:
+    """Most frequent value (ties -> smallest), as scipy.stats.mode."""
+    vals, counts = np.unique(np.asarray(values), return_counts=True)
+    return float(vals[np.argmax(counts)])

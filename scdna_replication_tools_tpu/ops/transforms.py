@@ -1,0 +1,55 @@
+"""Bijective constraint transforms for MAP optimisation.
+
+The reference's AutoDelta guide optimises constrained sites through
+torch's biject_to transforms (positive, unit_interval, interval, simplex);
+here the same constraints are expressed as explicit JAX bijections so every
+parameter lives in unconstrained space for Adam and is materialised in
+constrained space inside the compiled loss.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+def inv_softplus(y):
+    # log(exp(y) - 1), numerically stable for large y
+    return y + jnp.log(-jnp.expm1(-y))
+
+
+def to_positive(x):
+    return softplus(x)
+
+
+def from_positive(y):
+    return inv_softplus(jnp.asarray(y, jnp.float32))
+
+
+def to_unit_interval(x):
+    return jax.nn.sigmoid(x)
+
+
+def from_unit_interval(y):
+    y = jnp.clip(jnp.asarray(y, jnp.float32), 1e-6, 1.0 - 1e-6)
+    return jnp.log(y) - jnp.log1p(-y)
+
+
+def to_interval(x, lo, hi):
+    return lo + (hi - lo) * jax.nn.sigmoid(x)
+
+
+def from_interval(y, lo, hi):
+    return from_unit_interval((jnp.asarray(y, jnp.float32) - lo) / (hi - lo))
+
+
+def to_simplex(logits, axis=-1):
+    return jax.nn.softmax(logits, axis=axis)
+
+
+def from_simplex(p, axis=-1):
+    return jnp.log(jnp.clip(p, 1e-30, None))

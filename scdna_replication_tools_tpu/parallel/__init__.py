@@ -1,0 +1,7 @@
+from scdna_replication_tools_tpu.parallel.mesh import (
+    make_mesh,
+    shard_batch,
+    shard_params,
+)
+
+__all__ = ["make_mesh", "shard_batch", "shard_params"]

@@ -1,0 +1,15 @@
+from scdna_replication_tools_tpu.pipeline.consensus import (
+    add_cell_ploidies,
+    compute_consensus_clone_profiles,
+    filter_ploidies,
+)
+from scdna_replication_tools_tpu.pipeline.assign import assign_s_to_clones
+from scdna_replication_tools_tpu.pipeline.clustering import kmeans_cluster
+
+__all__ = [
+    "add_cell_ploidies",
+    "compute_consensus_clone_profiles",
+    "filter_ploidies",
+    "assign_s_to_clones",
+    "kmeans_cluster",
+]

@@ -1,0 +1,76 @@
+"""Clone discovery: KMeans over cell profiles with BIC model selection.
+
+Mirrors ``kmeans_cluster``/``compute_bic`` (reference: cncluster.py:49-120):
+KMeans is fit for k in [min_k, max_k] and the k maximising the BIC is
+kept.  The reference's optional umap+hdbscan path (cncluster.py:10-46) is
+dead code there (never called) and is provided here as a stub that raises
+with guidance, since umap/hdbscan are not available.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+import pandas as pd
+import sklearn.cluster
+
+
+def compute_bic(kmeans, X: np.ndarray) -> float:
+    """BIC of a fitted KMeans clustering (reference: cncluster.py:49-77)."""
+    centers = kmeans.cluster_centers_
+    labels = kmeans.labels_
+    n_clusters = kmeans.n_clusters
+    cluster_sizes = np.bincount(labels, minlength=n_clusters)
+    N, d = X.shape
+
+    cl_var = (1.0 / (N - n_clusters) / d) * sum(
+        np.sum((X[labels == i] - centers[i]) ** 2) for i in range(n_clusters)
+    )
+    const_term = 0.5 * n_clusters * np.log(N) * (d + 1)
+
+    sizes = cluster_sizes[cluster_sizes > 0]
+    bic = np.sum(
+        sizes * np.log(sizes)
+        - sizes * np.log(N)
+        - (sizes * d / 2) * np.log(2 * np.pi * cl_var)
+        - (sizes - 1) * d / 2
+    ) - const_term
+    return float(bic)
+
+
+def kmeans_cluster(cn: pd.DataFrame, min_k: int = 2, max_k: int = 100
+                   ) -> pd.DataFrame:
+    """Cluster cells; returns a (cell_id, cluster_id) frame.
+
+    ``cn`` is a (loci x cells) matrix frame (reference: cncluster.py:80-120).
+    """
+    X = cn.fillna(0).T.values
+    max_k = min(max_k, X.shape[0] - 1)
+    ks = range(min_k, max_k + 1)
+
+    models, bics = [], []
+    for k in ks:
+        model = sklearn.cluster.KMeans(n_clusters=k, init="k-means++",
+                                       n_init=10).fit(X)
+        models.append(model)
+        bics.append(compute_bic(model, X))
+        logging.debug("kmeans k=%d bic=%.2f", k, bics[-1])
+
+    opt = int(np.argmax(bics))
+    logging.info("kmeans_cluster selected k=%d", list(ks)[opt])
+    return pd.DataFrame({
+        "cell_id": cn.columns,
+        "cluster_id": models[opt].labels_,
+    })
+
+
+def umap_hdbscan_cluster(*args, **kwargs):
+    """Unavailable: umap/hdbscan are not bundled.
+
+    The reference defines this path (cncluster.py:10-46) but never calls
+    it; ``kmeans_cluster`` is the supported clustering entry point.
+    """
+    raise NotImplementedError(
+        "umap+hdbscan clustering requires the optional umap-learn and "
+        "hdbscan packages; use kmeans_cluster instead")

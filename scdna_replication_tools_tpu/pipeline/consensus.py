@@ -1,0 +1,77 @@
+"""Consensus per-clone pseudobulk profiles (pandas API parity).
+
+Vectorised re-implementation of ``compute_consensus_clone_profiles``
+(reference: compute_consensus_clone_profiles.py:17-88): per-cell ploidy is
+the modal CN state, clones keep only majority-ploidy cells, and the
+consensus is a per-locus aggregate (median by default) pivot.
+The reference's per-cell Python loop in ``add_cell_ploidies`` becomes one
+groupby aggregation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+
+def add_cell_ploidies(
+    cn: pd.DataFrame,
+    cell_col: str = "cell_id",
+    cn_state_col: str = "state",
+    ploidy_col: str = "ploidy",
+) -> pd.DataFrame:
+    """Ploidy = modal CN state per cell (reference:
+    compute_consensus_clone_profiles.py:30-39)."""
+    def _mode(s: pd.Series) -> float:
+        vals, counts = np.unique(s.to_numpy(), return_counts=True)
+        return float(vals[np.argmax(counts)])
+
+    ploidies = cn.groupby(cell_col, observed=True)[cn_state_col].agg(_mode)
+    cn = cn.copy()
+    cn[ploidy_col] = cn[cell_col].map(ploidies)
+    return cn
+
+
+def filter_ploidies(
+    cn: pd.DataFrame,
+    clone_col: str = "clone_id",
+    ploidy_col: str = "ploidy",
+) -> pd.DataFrame:
+    """Keep each clone's majority-ploidy cells (reference:
+    compute_consensus_clone_profiles.py:17-27)."""
+    pieces = []
+    for _, group in cn.groupby(clone_col, observed=True):
+        keep = group.groupby(ploidy_col, observed=True).size().idxmax()
+        pieces.append(group[group[ploidy_col] == keep])
+    return pd.concat(pieces, ignore_index=True)
+
+
+def compute_consensus_clone_profiles(
+    cn: pd.DataFrame,
+    col_name: str,
+    clone_col: str = "clone_id",
+    cell_col: str = "cell_id",
+    chr_col: str = "chr",
+    start_col: str = "start",
+    cn_state_col: str = "state",
+    ploidy_col: str = "ploidy",
+    aggfunc=np.median,
+) -> pd.DataFrame:
+    """(loci x clones) consensus profile frame for ``col_name``.
+
+    Mirrors the reference signature and semantics
+    (compute_consensus_clone_profiles.py:42-88), including dropping
+    'None' clones and the ploidy filter when ``cn_state_col`` is set.
+    """
+    cn = cn[cn[clone_col] != "None"].copy()
+
+    if cn_state_col is not None and cn_state_col in cn.columns:
+        cn = add_cell_ploidies(cn, cell_col=cell_col,
+                               cn_state_col=cn_state_col,
+                               ploidy_col=ploidy_col)
+        cn = filter_ploidies(cn, clone_col=clone_col, ploidy_col=ploidy_col)
+
+    return cn.pivot_table(
+        index=[chr_col, start_col], columns=clone_col, values=col_name,
+        aggfunc=aggfunc, observed=True,
+    )

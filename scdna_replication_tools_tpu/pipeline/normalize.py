@@ -1,0 +1,260 @@
+"""S-phase profile normalisation against G1 references.
+
+Covers the reference modules ``normalize_by_cell.py`` and
+``normalize_by_clone.py``:
+
+* :func:`normalize_by_cell` — each S cell is matched to its best-Pearson
+  G1 cell within the clone and normalised by that cell's CN states, then
+  cell-specific CNAs are removed via changepoint scanning
+  (reference: normalize_by_cell.py:216-267).  The per-cell Pearson loops
+  (:148-180) collapse into one masked (S x G1) correlation matrix.
+* :func:`normalize_by_clone` — each S cell is divided by its clone's
+  consensus profile (reference: normalize_by_clone.py:51-77).
+* :func:`remove_cell_specific_CNAs` — iterative 2-breakpoint interior scan
+  plus 1-breakpoint chr1/chrX edge scan with median-ratio and t-test gates
+  (reference: normalize_by_cell.py:35-145).  Note: the reference computes
+  its background as ``Y[~temp_indices]`` where ``temp_indices`` is an
+  *integer* array — a bitwise-not indexing bug that selects a mirrored
+  slice; here the background is what was plainly intended: every locus
+  outside the candidate region.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+import pandas as pd
+from scipy.stats import ttest_ind
+
+from scdna_replication_tools_tpu.ops.stats import masked_pearson_matrix
+from scdna_replication_tools_tpu.pipeline.consensus import add_cell_ploidies
+from scdna_replication_tools_tpu.pipeline.segment import find_breakpoints
+from scdna_replication_tools_tpu.utils.chrom import sort_by_cell_and_loci
+
+
+def scale(x: np.ndarray) -> np.ndarray:
+    """Center/scale like sklearn.preprocessing.scale (population std)."""
+    x = np.asarray(x, np.float64)
+    sd = x.std()
+    return (x - x.mean()) / (sd if sd > 0 else 1.0)
+
+
+def identify_changepoint_segs(y: np.ndarray, chroms: np.ndarray,
+                              max_rounds: int = 20):
+    """Iteratively nominate and flatten CNA segments in one profile.
+
+    Mirrors ``identify_changepoint_segs``
+    (reference: normalize_by_cell.py:35-113): interior 2-breakpoint scan
+    until no significant region, then chr1-start / chrX-end 1-breakpoint
+    scan (losses on chr1, gains on chrX only, :96-100).
+    """
+    y = np.asarray(y, np.float64).copy()
+    chroms = np.asarray(chroms).astype(str)
+    chng = np.zeros(len(y))
+    j = 1
+
+    for _ in range(max_rounds):
+        bkps = find_breakpoints(y, n_bkps=2)
+        if len(bkps) < 3:
+            break
+        a, b = bkps[0], bkps[1]
+        region = y[a:b]
+        background = np.concatenate([y[:a], y[b:]])
+        if len(region) == 0 or len(background) == 0:
+            break
+        median_ratio = np.median(region) / np.median(background)
+        _, pval = ttest_ind(region, background)
+        same_chr = chroms[a] == chroms[b - 1]
+        if (median_ratio > 1.1 or median_ratio < 0.9) and pval < 0.05 \
+                and same_chr:
+            chng[a:b] = j
+            j += 1
+            y[a:b] /= median_ratio
+        else:
+            break
+
+    for _ in range(max_rounds):
+        bkps = find_breakpoints(y, n_bkps=1)
+        ind = bkps[0]
+        if ind <= 0 or ind >= len(y):
+            break
+        left_chr = chroms[ind]
+        right_chr = chroms[ind - 1]
+        if right_chr == "1":
+            sl = slice(0, ind)
+        elif left_chr == "X":
+            sl = slice(ind, len(y))
+        else:
+            break
+        region = y[sl]
+        background = np.concatenate([y[:sl.start], y[sl.stop:]])
+        if len(region) == 0 or len(background) == 0:
+            break
+        median_ratio = np.median(region) / np.median(background)
+        _, pval = ttest_ind(region, background)
+        if ((median_ratio > 1.1 and left_chr == "X")
+                or (median_ratio < 0.9 and right_chr == "1")) and pval < 0.05:
+            chng[sl] = j
+            j += 1
+            y[sl] /= median_ratio
+        else:
+            break
+
+    return y, chng
+
+
+def remove_cell_specific_CNAs(cell_cn: pd.DataFrame, input_col='copy_norm',
+                              output_col='rt_value',
+                              seg_col='changepoint_segments',
+                              cell_col='cell_id', chr_col='chr',
+                              start_col='start') -> pd.DataFrame:
+    """Per-cell CNA removal + per-segment scaling
+    (reference: normalize_by_cell.py:116-145)."""
+    cell_cn = sort_by_cell_and_loci(cell_cn, cell_col=cell_col,
+                                    chr_col=chr_col, start_col=start_col)
+    x = cell_cn[input_col].to_numpy(np.float64)
+
+    # trim the tails of the distribution before changepoint search (:122-128)
+    x2 = np.where(scale(x) < 4, x, np.percentile(x, 95))
+    x2 = np.where(scale(x2) > -4, x2, np.percentile(x2, 5))
+
+    y, chng = identify_changepoint_segs(
+        x2, cell_cn[chr_col].to_numpy())
+
+    cell_cn = cell_cn.copy()
+    cell_cn[seg_col] = chng
+
+    # scale within each nominated segment, then overall (:137-143)
+    scaled = np.empty_like(y)
+    for seg in np.unique(chng):
+        sel = chng == seg
+        scaled[sel] = scale(y[sel])
+    cell_cn[output_col] = scale(scaled)
+    return cell_cn
+
+
+def _pivot(cn: pd.DataFrame, value_col, cell_col, chr_col, start_col):
+    cn = cn.copy()
+    cn[chr_col] = cn[chr_col].astype(str)
+    return cn.pivot_table(index=cell_col, columns=[chr_col, start_col],
+                          values=value_col, dropna=False, observed=True)
+
+
+def normalize_by_cell(cn_s: pd.DataFrame, cn_g1: pd.DataFrame,
+                      input_col='rpm_gc_norm', clone_col='clone_id',
+                      cell_col='cell_id', temp_col='temp_rt',
+                      output_col='rt_value',
+                      seg_col='changepoint_segments', chr_col='chr',
+                      start_col='start', cn_state_col='state',
+                      ploidy_col='ploidy') -> pd.DataFrame:
+    """Match each S cell to its best G1 cell and normalise
+    (reference: normalize_by_cell.py:216-267)."""
+    cn_s = cn_s.dropna().copy()
+    cn_g1 = cn_g1.dropna().copy()
+
+    cn_s = add_cell_ploidies(cn_s, cell_col, cn_state_col, ploidy_col)
+    cn_g1 = add_cell_ploidies(cn_g1, cell_col, cn_state_col, ploidy_col)
+
+    s_mat = _pivot(cn_s, input_col, cell_col, chr_col, start_col)
+    g1_mat = _pivot(cn_g1, input_col, cell_col, chr_col, start_col)
+    g1_mat = g1_mat.reindex(columns=s_mat.columns)
+    g1_state_mat = _pivot(cn_g1, cn_state_col, cell_col, chr_col, start_col)
+    g1_state_mat = g1_state_mat.reindex(columns=s_mat.columns)
+
+    corr = masked_pearson_matrix(s_mat.to_numpy(np.float64),
+                                 g1_mat.to_numpy(np.float64))
+
+    # restrict matches to the S cell's clone when both frames carry clones
+    if clone_col in cn_s.columns and clone_col in cn_g1.columns:
+        s_clones = cn_s[[cell_col, clone_col]].drop_duplicates(cell_col) \
+            .set_index(cell_col)[clone_col].reindex(s_mat.index).astype(str)
+        g1_clones = cn_g1[[cell_col, clone_col]].drop_duplicates(cell_col) \
+            .set_index(cell_col)[clone_col].reindex(g1_mat.index).astype(str)
+        same = s_clones.to_numpy()[:, None] == g1_clones.to_numpy()[None, :]
+        corr = np.where(same, corr, -np.inf)
+    corr = np.nan_to_num(corr, nan=-np.inf)
+    best = np.argmax(corr, axis=1)
+
+    s_ploidy = cn_s[[cell_col, ploidy_col]].drop_duplicates(cell_col) \
+        .set_index(cell_col)[ploidy_col].reindex(s_mat.index).to_numpy()
+    g1_ploidy = cn_g1[[cell_col, ploidy_col]].drop_duplicates(cell_col) \
+        .set_index(cell_col)[ploidy_col].reindex(g1_mat.index).to_numpy()
+
+    chr_vals = s_mat.columns.get_level_values(0).astype(str)
+    start_vals = s_mat.columns.get_level_values(1)
+
+    out = []
+    eps = np.finfo(float).eps
+    for i, s_cell in enumerate(s_mat.index):
+        g1_idx = best[i]
+        g1_cell = g1_mat.index[g1_idx]
+        s_vals = s_mat.iloc[i].to_numpy(np.float64)
+        g1_states = g1_state_mat.iloc[g1_idx].to_numpy(np.float64)
+        # (s * ploidy_g1) / (state_g1 * ploidy_s)
+        # (reference: normalize_by_cell.py:205-206)
+        norm = (s_vals * g1_ploidy[g1_idx]) / \
+            (g1_states * s_ploidy[i] + eps)
+        valid = np.isfinite(norm)
+        df = pd.DataFrame({
+            chr_col: chr_vals[valid],
+            start_col: np.asarray(start_vals)[valid],
+            cell_col: s_cell,
+            temp_col: scale(norm[valid]),          # :209
+            "G1_match_cell_id": g1_cell,
+            "G1_match_pearsonr": corr[i, g1_idx],
+        })
+        df = remove_cell_specific_CNAs(df, input_col=temp_col,
+                                       output_col=output_col,
+                                       seg_col=seg_col, cell_col=cell_col,
+                                       chr_col=chr_col, start_col=start_col)
+        out.append(df)
+
+    out = pd.concat(out, ignore_index=True)
+    return pd.merge(out, cn_s)
+
+
+def cell_clone_norm(clone_profiles: pd.DataFrame, cell_cn: pd.DataFrame,
+                    clone_id, input_col, output_col, chr_col='chr',
+                    start_col='start') -> pd.DataFrame:
+    """Divide one cell's profile by its clone consensus
+    (reference: normalize_by_clone.py:22-48)."""
+    merged = pd.merge(
+        cell_cn.reset_index(),
+        clone_profiles[[clone_id]].reset_index(),
+        on=[chr_col, start_col])
+    merged[output_col] = merged[input_col] / \
+        (merged[clone_id] + np.finfo(float).eps)
+    return merged.drop(columns=[clone_id]).sort_values([chr_col, start_col])
+
+
+def normalize_by_clone(cn_s: pd.DataFrame, clone_profiles: pd.DataFrame,
+                       input_col='rpm_gc_norm', clone_col='clone_id',
+                       cell_col='cell_id', output_col='rt_value',
+                       chr_col='chr', start_col='start',
+                       cn_state_col='state', ploidy_col='ploidy'
+                       ) -> pd.DataFrame:
+    """Divide every S cell by its clone's consensus profile
+    (reference: normalize_by_clone.py:51-77)."""
+    cn_s = cn_s.dropna().copy()
+    clone_profiles = clone_profiles.dropna()
+    if not isinstance(clone_profiles.index, pd.MultiIndex):
+        clone_profiles = clone_profiles.set_index([chr_col, start_col])
+    # align chromosome dtype with the long frame
+    clone_profiles = clone_profiles.copy()
+    clone_profiles.index = pd.MultiIndex.from_arrays(
+        [clone_profiles.index.get_level_values(0).astype(str),
+         clone_profiles.index.get_level_values(1)],
+        names=[chr_col, start_col])
+    cn_s[chr_col] = cn_s[chr_col].astype(str)
+
+    if cn_state_col in cn_s.columns:
+        cn_s = add_cell_ploidies(cn_s, cell_col, cn_state_col, ploidy_col)
+
+    out = []
+    for cell_id, cell_cn in cn_s.groupby(cell_col, observed=True):
+        clone_id = cell_cn[clone_col].iloc[0]
+        out.append(cell_clone_norm(
+            clone_profiles, cell_cn.set_index([chr_col, start_col]),
+            clone_id, input_col, output_col, chr_col, start_col))
+    return pd.concat(out, ignore_index=True)

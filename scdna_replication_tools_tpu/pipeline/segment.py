@@ -1,0 +1,69 @@
+"""Least-squares changepoint detection (ruptures.KernelCPD replacement).
+
+The reference segments per-cell profiles with ``ruptures.KernelCPD
+(kernel='linear', min_size=2)`` for 1 or 2 breakpoints
+(reference: normalize_by_cell.py:45-46, 73-74).  For the linear kernel
+KernelCPD minimises the within-segment sum of squared deviations from the
+segment mean, which for 1-2 breakpoints is solved exactly here with
+prefix-sum cost evaluation — O(n) for one breakpoint, O(n^2) vectorised
+for two — no external dependency.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+
+def _segment_cost_table(y: np.ndarray):
+    """Returns cost(i, j) = sum of squared deviation of y[i:j] from its
+    mean, as a callable backed by prefix sums."""
+    s1 = np.concatenate([[0.0], np.cumsum(y)])
+    s2 = np.concatenate([[0.0], np.cumsum(y * y)])
+
+    def cost(i, j):
+        n = j - i
+        tot = s1[j] - s1[i]
+        return (s2[j] - s2[i]) - tot * tot / np.maximum(n, 1)
+
+    return cost
+
+
+def find_breakpoints(y: np.ndarray, n_bkps: int, min_size: int = 2
+                     ) -> List[int]:
+    """Optimal breakpoints, returned like ruptures' ``predict``: sorted
+    end indices of each segment *excluding* 0 but including len(y)."""
+    y = np.asarray(y, np.float64)
+    n = len(y)
+    cost = _segment_cost_table(y)
+
+    if n_bkps == 1:
+        ks = np.arange(min_size, n - min_size + 1)
+        if len(ks) == 0:
+            return [n]
+        costs = cost(0, ks) + cost(ks, n)
+        k = int(ks[np.argmin(costs)])
+        return [k, n]
+
+    if n_bkps == 2:
+        # all (a, b) pairs with min_size spacing, vectorised over b per a
+        best = (np.inf, None)
+        a_vals = np.arange(min_size, n - 2 * min_size + 1)
+        if len(a_vals) == 0:
+            return [n]
+        left = cost(0, a_vals)
+        for idx, a in enumerate(a_vals):
+            b_vals = np.arange(a + min_size, n - min_size + 1)
+            if len(b_vals) == 0:
+                continue
+            tot = left[idx] + cost(a, b_vals) + cost(b_vals, n)
+            j = int(np.argmin(tot))
+            if tot[j] < best[0]:
+                best = (tot[j], (int(a), int(b_vals[j])))
+        if best[1] is None:
+            return [n]
+        a, b = best[1]
+        return [a, b, n]
+
+    raise NotImplementedError("only 1 or 2 breakpoints are supported")

@@ -1,0 +1,3 @@
+from scdna_replication_tools_tpu.utils.chrom import CHR_ORDER, sort_by_cell_and_loci
+
+__all__ = ["CHR_ORDER", "sort_by_cell_and_loci"]

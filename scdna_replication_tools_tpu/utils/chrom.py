@@ -1,0 +1,34 @@
+"""Genome-ordering helpers for long-form scWGS DataFrames.
+
+Replicates the chromosome categorical ordering used throughout the
+reference (reference: pert_model.py:194-203, normalize_by_cell.py:24-32):
+chromosomes 1..22 then X then Y, sorted within cell by (chr, start).
+"""
+
+from __future__ import annotations
+
+import pandas as pd
+
+CHR_ORDER = [str(i + 1) for i in range(22)] + ["X", "Y"]
+
+
+def as_chr_categorical(series: pd.Series) -> pd.Series:
+    """Cast a chromosome column to the canonical ordered categorical."""
+    s = series.astype(str).astype("category")
+    return s.cat.set_categories(CHR_ORDER, ordered=True)
+
+
+def sort_by_cell_and_loci(
+    cn: pd.DataFrame,
+    cell_col: str = "cell_id",
+    chr_col: str = "chr",
+    start_col: str = "start",
+) -> pd.DataFrame:
+    """Sort a long-form frame so each cell follows genomic order.
+
+    Mirrors ``pert_infer_scRT.sort_by_cell_and_loci``
+    (reference: pert_model.py:194-203).
+    """
+    cn = cn.copy()
+    cn[chr_col] = as_chr_categorical(cn[chr_col])
+    return cn.sort_values(by=[cell_col, chr_col, start_col], kind="mergesort")

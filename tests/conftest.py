@@ -1,0 +1,77 @@
+"""Test environment: 8 virtual CPU devices for multi-chip sharding tests.
+
+Must run before the first ``import jax`` anywhere in the test session.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the shell presets axon (TPU)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# jax is pre-imported by the environment's sitecustomize before conftest
+# runs, so the env var alone is not enough — override the live config too
+# (the backend itself is still uninitialised at this point).
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pandas as pd
+import pytest
+
+
+@pytest.fixture(scope="session")
+def synthetic_frames():
+    """Small synthetic 2-clone dataset in the reference's long-form contract.
+
+    Mirrors the shape of the reference's simulator test fixture
+    (reference: test_with_pytest.py:22-58) but with generated GC/RT
+    profiles instead of the bundled mcfrt.csv.
+    """
+    rng = np.random.default_rng(7)
+    num_loci = 120
+    chrom = "1"
+    starts = (np.arange(num_loci) * 500_000).astype(np.int64)
+    gc = np.clip(0.45 + 0.08 * np.sin(np.arange(num_loci) / 9.0)
+                 + rng.normal(0, 0.02, num_loci), 0.3, 0.65)
+    # smooth replication-timing profile (early ~ high values)
+    rt = 0.5 + 0.45 * np.sin(np.arange(num_loci) / 15.0 + 1.0)
+    rt_b = 0.5 + 0.45 * np.sin(np.arange(num_loci) / 15.0 + 2.2)
+
+    def make_cells(prefix, n, clone, cn_profile):
+        frames = []
+        for i in range(n):
+            frames.append(pd.DataFrame({
+                "cell_id": f"{prefix}_{clone}_{i}",
+                "chr": chrom,
+                "start": starts,
+                "end": starts + 500_000,
+                "gc": gc,
+                "mcf7rt": rt,
+                "rt_A": rt,
+                "rt_B": rt_b,
+                "library_id": "LIB0",
+                "clone_id": clone,
+                "true_somatic_cn": cn_profile,
+            }))
+        return frames
+
+    cn_a = np.full(num_loci, 2.0)
+    cn_a[80:100] = 4.0  # clone A carries an amplification
+    cn_b = np.full(num_loci, 2.0)
+    cn_b[20:50] = 3.0   # clone B carries a gain
+
+    n_per_clone = 12
+    df_s = pd.concat(
+        make_cells("s", n_per_clone, "A", cn_a)
+        + make_cells("s", n_per_clone, "B", cn_b),
+        ignore_index=True)
+    df_g = pd.concat(
+        make_cells("g", n_per_clone, "A", cn_a)
+        + make_cells("g", n_per_clone, "B", cn_b),
+        ignore_index=True)
+    return df_s, df_g

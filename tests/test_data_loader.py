@@ -1,0 +1,57 @@
+"""Data-core tests: pivots, genome ordering, padding/masking."""
+
+import numpy as np
+import pandas as pd
+
+from scdna_replication_tools_tpu.config import ColumnConfig
+from scdna_replication_tools_tpu.data.loader import (
+    build_pert_inputs,
+    pad_cells,
+)
+
+
+def _with_reads(df, seed=0):
+    rng = np.random.default_rng(seed)
+    df = df.copy()
+    df["reads"] = rng.integers(10, 100, len(df))
+    df["state"] = df["true_somatic_cn"]
+    df["copy"] = df["true_somatic_cn"].astype(float)
+    return df
+
+
+def test_build_pert_inputs_shapes(synthetic_frames):
+    df_s, df_g = synthetic_frames
+    s, g1 = build_pert_inputs(_with_reads(df_s), _with_reads(df_g, 1))
+    assert s.reads.shape == (24, 120)
+    assert g1.reads.shape == (24, 120)
+    assert g1.states.shape == (24, 120)
+    assert s.gammas.shape == (120,)
+    assert s.rt_prior is not None and s.rt_prior.max() <= 1.0
+    assert s.libs.shape == (24,)
+    assert list(s.loci.get_level_values(1)) == sorted(
+        s.loci.get_level_values(1))
+
+
+def test_genome_ordering_multichrom():
+    # chromosomes must order 1..22,X,Y — not lexicographically
+    rows = []
+    for chrom in ["10", "2", "1", "X"]:
+        for start in [0, 500000]:
+            rows.append(dict(cell_id="c0", chr=chrom, start=start,
+                             gc=0.4, reads=5, state=2, library_id="L"))
+    df = pd.DataFrame(rows)
+    cols = ColumnConfig(rt_prior_col=None)
+    s, g1 = build_pert_inputs(df, df.copy(), cols)
+    chrs = list(s.loci.get_level_values(0).astype(str))
+    assert chrs == ["1", "1", "2", "2", "10", "10", "X", "X"]
+
+
+def test_pad_cells_mask(synthetic_frames):
+    df_s, df_g = synthetic_frames
+    s, _ = build_pert_inputs(_with_reads(df_s), _with_reads(df_g, 1))
+    padded = pad_cells(s, 16)
+    assert padded.num_cells == 32
+    assert padded.cell_mask.sum() == 24
+    assert not padded.cell_mask[-1]
+    # original content preserved
+    np.testing.assert_array_equal(padded.reads[:24], s.reads)

@@ -1,0 +1,84 @@
+"""Simulate-and-recover: the quantitative acceptance test.
+
+The reference only eyeballs recovery in notebooks (SURVEY.md §4); here it
+is automated — PERT inference must recover the simulator's ground truth
+(replication states, somatic CN, per-cell S-phase times) from read counts
+alone.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from scdna_replication_tools_tpu.api import scRT
+from scdna_replication_tools_tpu.models.simulator import pert_simulator
+
+
+@pytest.fixture(scope="module")
+def sim_data(synthetic_frames):
+    df_s, df_g = synthetic_frames
+    sim_s, sim_g = pert_simulator(
+        df_s, df_g, num_reads=50_000, rt_cols=["rt_A", "rt_B"],
+        clones=["A", "B"], lamb=0.75, betas=[0.5, 0.0], a=10.0, seed=11)
+    for df in (sim_s, sim_g):
+        df["reads"] = df["true_reads_norm"]
+        df["state"] = df["true_somatic_cn"].astype(int)
+        df["copy"] = df["true_somatic_cn"].astype(float)
+    return sim_s, sim_g
+
+
+@pytest.fixture(scope="module")
+def pert_output(sim_data):
+    sim_s, sim_g = sim_data
+    scrt = scRT(sim_s.copy(), sim_g.copy(), input_col="reads",
+                clone_col="clone_id", assign_col="copy",
+                cn_prior_method="g1_clones", max_iter=400, min_iter=100,
+                rt_prior_col=None, run_step3=True)
+    return scrt.infer(level="pert")
+
+
+def test_output_contract(pert_output):
+    cn_s_out, supp_s, cn_g1_out, supp_g1 = pert_output
+    for col in ["model_cn_state", "model_rep_state", "model_tau", "model_u",
+                "model_rho"]:
+        assert col in cn_s_out.columns, col
+        assert col in cn_g1_out.columns, col
+    assert {"model_lambda", "model_a", "loss_g", "loss_s"} <= \
+        set(supp_s["param"].unique())
+    # loss curves decreased
+    loss_s = supp_s.query("param == 'loss_s'")["value"].to_numpy()
+    assert loss_s[-1] < loss_s[0]
+
+
+def test_recovers_replication_states(pert_output):
+    cn_s_out, *_ = pert_output
+    acc = (cn_s_out["model_rep_state"] == cn_s_out["true_rep"]).mean()
+    assert acc > 0.80, f"rep-state accuracy {acc:.3f}"
+
+
+def test_recovers_somatic_cn(pert_output):
+    cn_s_out, *_ = pert_output
+    acc = (cn_s_out["model_cn_state"] == cn_s_out["true_somatic_cn"]).mean()
+    assert acc > 0.90, f"CN accuracy {acc:.3f}"
+
+
+def test_recovers_tau_ordering(pert_output):
+    cn_s_out, *_ = pert_output
+    per_cell = cn_s_out.groupby("cell_id").agg(
+        tau=("model_tau", "first"), true_t=("true_t", "first"))
+    r = np.corrcoef(per_cell["tau"], per_cell["true_t"])[0, 1]
+    assert r > 0.8, f"tau correlation {r:.3f}"
+
+
+def test_recovers_lambda(pert_output):
+    _, supp_s, *_ = pert_output
+    lamb = supp_s.query("param == 'model_lambda'")["value"].iloc[0]
+    assert 0.5 < lamb < 0.95, f"lambda {lamb:.3f} vs true 0.75"
+
+
+def test_g1_cells_mostly_unreplicated(pert_output):
+    _, _, cn_g1_out, _ = pert_output
+    # step 3 reruns the S model on G1 cells; their replicated fraction
+    # should be extreme (near 0 or 1 is how PERT flags non-replicating)
+    frac = cn_g1_out.groupby("cell_id")["model_rep_state"].mean()
+    assert ((frac < 0.2) | (frac > 0.8)).mean() > 0.7

@@ -1,0 +1,134 @@
+"""Pipeline-stage tests: segment, lowess, consensus, assignment, SPF,
+phase calling, pseudobulk, twidth, deterministic levels."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from scdna_replication_tools_tpu.pipeline.segment import find_breakpoints
+from scdna_replication_tools_tpu.pipeline.gc_correction import (
+    bulk_g1_gc_correction,
+    lowess,
+)
+from scdna_replication_tools_tpu.pipeline.pseudobulk import (
+    compute_pseudobulk_rt_profiles,
+)
+from scdna_replication_tools_tpu.pipeline.twidth import (
+    calculate_twidth,
+    compute_time_from_scheduled_column,
+)
+from scdna_replication_tools_tpu.pipeline.phase import predict_cycle_phase
+from scdna_replication_tools_tpu.api import SPF
+
+
+def test_find_breakpoints_single():
+    y = np.concatenate([np.zeros(50), np.ones(50) * 3.0])
+    bkps = find_breakpoints(y, n_bkps=1)
+    assert bkps == [50, 100]
+
+
+def test_find_breakpoints_double():
+    y = np.concatenate([np.zeros(40), np.ones(30) * 3.0, np.zeros(40)])
+    bkps = find_breakpoints(y, n_bkps=2)
+    assert bkps == [40, 70, 110]
+
+
+def test_lowess_recovers_smooth_trend():
+    rng = np.random.default_rng(0)
+    x = np.linspace(0, 1, 300)
+    y = np.sin(2 * x) + rng.normal(0, 0.05, 300)
+    xv = np.linspace(0.1, 0.9, 20)
+    pred = lowess(y, x, xv, frac=0.3)
+    np.testing.assert_allclose(pred, np.sin(2 * xv), atol=0.08)
+
+
+def test_bulk_gc_correction_flattens_gc_trend(synthetic_frames):
+    df_s, df_g = synthetic_frames
+    rng = np.random.default_rng(2)
+    for df in (df_s, df_g):
+        # reads strongly driven by GC
+        df["reads"] = rng.poisson(100 * np.exp(2.0 * df["gc"]))
+    cn_s, cn_g1 = bulk_g1_gc_correction(df_s.copy(), df_g.copy())
+    # after correction, correlation of normalised reads with GC ~ 0
+    r_before = np.corrcoef(cn_g1["reads"], cn_g1["gc"])[0, 1]
+    r_after = np.corrcoef(cn_g1["rpm_gc_norm"], cn_g1["gc"])[0, 1]
+    assert abs(r_after) < 0.1 < abs(r_before)
+
+
+def test_spf_fractions(synthetic_frames):
+    df_s, df_g = synthetic_frames
+    rng = np.random.default_rng(3)
+    for df in (df_s, df_g):
+        df["reads"] = rng.poisson(
+            40 * df["true_somatic_cn"].to_numpy()).astype(float)
+    spf = SPF(df_s.copy(), df_g.copy(), input_col="reads",
+              clone_col="clone_id")
+    _, out = spf.infer()
+    assert set(out.columns) == {"clone_id", "SPF", "SPF_std", "num_s",
+                                "num_g"}
+    # both clones have 12 S and 12 G cells -> SPF 0.5 each
+    np.testing.assert_allclose(out["SPF"], 0.5)
+    assert (out["SPF_std"] > 0).all()
+
+
+def _phase_input():
+    rng = np.random.default_rng(4)
+    rows = []
+    for i in range(6):
+        n = 200
+        if i < 3:  # replicating cells
+            rep = (rng.random(n) < 0.5).astype(float)
+        else:      # non-replicating
+            rep = np.zeros(n)
+        rows.append(pd.DataFrame({
+            "cell_id": f"c{i}",
+            "chr": "1",
+            "start": np.arange(n),
+            "model_rep_state": rep,
+            "model_cn_state": 2,
+            "rpm": rng.poisson(50, n).astype(float),
+        }))
+    return pd.concat(rows, ignore_index=True)
+
+
+def test_predict_cycle_phase_splits_cells():
+    cn = _phase_input()
+    cn_s, cn_g, cn_lq = predict_cycle_phase(cn)
+    s_cells = set(cn_s["cell_id"].unique())
+    g_cells = set(cn_g["cell_id"].unique())
+    assert {"c0", "c1", "c2"} <= s_cells | set(cn_lq["cell_id"].unique())
+    assert {"c3", "c4", "c5"} <= g_cells
+    assert (cn_g["PERT_phase"] == "G1/2").all()
+
+
+def test_pseudobulk_and_twidth():
+    rng = np.random.default_rng(5)
+    n_loci, n_cells = 150, 30
+    rho = np.linspace(0.9, 0.1, n_loci)  # early -> late gradient
+    rows = []
+    for i in range(n_cells):
+        tau = (i + 1) / (n_cells + 1)
+        rep = (rng.random(n_loci) < 1 / (1 + np.exp(-8 * (tau - rho)))
+               ).astype(float)
+        rows.append(pd.DataFrame({
+            "cell_id": f"c{i}", "chr": "1", "start": np.arange(n_loci),
+            "clone_id": "A", "rt_state": rep, "frac_rt": rep.mean(),
+        }))
+    cn = pd.concat(rows, ignore_index=True)
+
+    bulk = compute_pseudobulk_rt_profiles(cn, "rt_state")
+    assert "pseudobulk_rt_state" in bulk.columns
+    assert "pseudobulk_hours" in bulk.columns
+    assert bulk["pseudobulk_hours"].max() == pytest.approx(10.0)
+    # early loci (high mean rep) -> small hours
+    r = np.corrcoef(bulk["pseudobulk_rt_state"], bulk["pseudobulk_hours"])[0, 1]
+    assert r < -0.9
+
+    cn = pd.merge(cn, bulk)
+    cn = compute_time_from_scheduled_column(
+        cn, pseudobulk_col="pseudobulk_hours", frac_rt_col="frac_rt")
+    t_width, right, left, popt, tb, pr = calculate_twidth(cn)
+    assert np.isfinite(t_width)
+    # %-replicated decreases with time-from-scheduled, so the 25% point
+    # lies right of the 75% point and t_width is positive
+    assert 0 < t_width < 20

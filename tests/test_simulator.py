@@ -1,0 +1,61 @@
+"""Simulator tests — the analog of the reference's de-facto integration
+test (reference: test_with_pytest.py:11-78)."""
+
+import numpy as np
+import pandas as pd
+
+from scdna_replication_tools_tpu.models.simulator import (
+    convert_rt_units,
+    pert_simulator,
+)
+
+
+def test_convert_rt_units():
+    rt = np.array([0.0, 5.0, 10.0])
+    out = convert_rt_units(rt)
+    # largest raw values (latest in source units) map to 0
+    np.testing.assert_allclose(out, [1.0, 0.5, 0.0])
+
+
+def test_pert_simulator_output_columns(synthetic_frames):
+    df_s, df_g = synthetic_frames
+    sim_s, sim_g = pert_simulator(
+        df_s, df_g, num_reads=50_000, rt_cols=["rt_A", "rt_B"],
+        clones=["A", "B"], lamb=0.75, betas=[0.5, 0.0], a=10.0)
+
+    for col in ["true_reads_norm", "true_reads_raw", "true_rep",
+                "true_p_rep", "true_t", "true_total_cn"]:
+        assert col in sim_s.columns, col
+        assert col in sim_g.columns, col
+
+    # G1 cells must be fully unreplicated (reference: test_with_pytest.py:69-78)
+    assert (sim_g["true_rep"] == 0).all()
+    assert (sim_g["true_t"] == 0).all()
+
+    # every S cell's replication fraction in [0, 1]; taus spread over (0,1)
+    fracs = sim_s.groupby("cell_id")["true_rep"].mean()
+    assert fracs.between(0, 1).all()
+    taus = sim_s.groupby("cell_id")["true_t"].first()
+    assert taus.between(0, 1).all()
+    assert taus.std() > 0.05
+
+    # total CN doubles where replicated
+    rep_rows = sim_s[sim_s["true_rep"] == 1]
+    np.testing.assert_allclose(rep_rows["true_total_cn"],
+                               rep_rows["true_somatic_cn"] * 2)
+
+    # read counts roughly normalised to num_reads per cell
+    per_cell = sim_s.groupby("cell_id")["true_reads_norm"].sum()
+    assert (np.abs(per_cell - 50_000) < 500).all()
+
+
+def test_simulator_replication_follows_tau(synthetic_frames):
+    """Cells late in S phase (large tau) must have more replicated bins."""
+    df_s, df_g = synthetic_frames
+    sim_s, _ = pert_simulator(
+        df_s, df_g, num_reads=50_000, rt_cols=["rt_A", "rt_B"],
+        clones=["A", "B"], lamb=0.75, betas=[0.5, 0.0], a=10.0, seed=3)
+    per_cell = sim_s.groupby("cell_id").agg(
+        frac=("true_rep", "mean"), tau=("true_t", "first"))
+    r = np.corrcoef(per_cell["frac"], per_cell["tau"])[0, 1]
+    assert r > 0.9
