@@ -48,12 +48,11 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.scipy.special import logsumexp
+from jax.scipy.special import gammaln, logsumexp
 
 from scdna_replication_tools_tpu.ops.dists import (
     bernoulli_log_prob,
     beta_log_prob,
-    dirichlet_log_prob,
     gamma_log_prob,
     nb_log_prob,
     normal_log_prob,
@@ -227,7 +226,11 @@ def constrained(spec: PertModelSpec, params: dict, fixed: dict) -> dict:
     out["tau"] = to_unit_interval(params["tau_raw"])
     out["u"] = params["u"]
     out["betas"] = params["betas"]
-    out["pi"] = jax.nn.softmax(params["pi_logits"], axis=-1)
+    # log-space simplex: log_softmax stays finite even when a disfavored
+    # state's float32 probability underflows to 0 (log(softmax(x)) would
+    # give -inf and NaN gradients under the huge 1e6 prior concentrations)
+    out["log_pi"] = jax.nn.log_softmax(params["pi_logits"], axis=-1)
+    out["pi"] = jnp.exp(out["log_pi"])
     return out
 
 
@@ -338,10 +341,16 @@ def log_joint(spec: PertModelSpec, params: dict, fixed: dict,
     lp += jnp.sum(_per_cell_log_prior(spec, c, batch, reads_mean, ploidies) * mask)
 
     # pi ~ Dirichlet(etas) per (cell, locus) (reference: pert_model.py:608-611)
+    # computed from log_pi: (etas-1)*log_pi is finite because log_softmax
+    # never returns -inf, unlike log(softmax)
     etas = batch.etas if batch.etas is not None else \
         jnp.ones((num_cells, num_loci, spec.P), jnp.float32)
-    log_pi = jnp.log(c["pi"])
-    lp_pi = dirichlet_log_prob(c["pi"], etas, axis=-1)
+    log_pi = c["log_pi"]
+    lp_pi = (
+        jnp.sum((etas - 1.0) * log_pi, axis=-1)
+        + gammaln(jnp.sum(etas, axis=-1))
+        - jnp.sum(gammaln(etas), axis=-1)
+    )
     lp += jnp.sum(lp_pi * mask[:, None])
 
     phi = _phi(c, num_loci)
@@ -419,7 +428,7 @@ def decode_discrete(spec: PertModelSpec, params: dict, fixed: dict,
     """
     c = constrained(spec, params, fixed)
     lamb, log_lamb, log1m_lamb = _nb_pieces(c)
-    log_pi = jnp.log(c["pi"])
+    log_pi = c["log_pi"]
     phi = _phi(c, batch.reads.shape[1])
     omega = gc_rate(c["betas"], batch.gamma_feats)
 

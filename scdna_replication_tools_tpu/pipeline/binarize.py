@@ -27,13 +27,14 @@ def binarize_profiles(cn: pd.DataFrame, input_col: str,
     """Returns (cn with rt_state/frac_rt/binary_thresh/GMM columns added,
     manhattan_df of all scanned thresholds)."""
     cn = cn.copy()
-    cn["chr"] = cn["chr"].astype(str) if "chr" in cn.columns else None
-
-    mat = cn.pivot_table(index=cell_col, columns=["chr", "start"],
-                         values=input_col, dropna=False, observed=True) \
-        if "chr" in cn.columns else \
-        cn.pivot_table(index=cell_col, columns="start", values=input_col,
-                       dropna=False, observed=True)
+    has_chr = "chr" in cn.columns
+    if has_chr:
+        cn["chr"] = cn["chr"].astype(str)
+        mat = cn.pivot_table(index=cell_col, columns=["chr", "start"],
+                             values=input_col, dropna=False, observed=True)
+    else:
+        mat = cn.pivot_table(index=cell_col, columns="start",
+                             values=input_col, dropna=False, observed=True)
 
     vals = mat.to_numpy(np.float32)
     nan_mask = ~np.isfinite(vals)
@@ -60,7 +61,7 @@ def binarize_profiles(cn: pd.DataFrame, input_col: str,
         return df.T.melt(ignore_index=False, value_name=name).reset_index()
 
     melted = _melt(rt_state, rs_col).dropna()
-    if "chr" in melted.columns:
+    if has_chr:
         melted["chr"] = melted["chr"].astype(str)
     cn = pd.merge(cn, melted)
 

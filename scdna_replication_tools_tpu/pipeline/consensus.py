@@ -13,6 +13,8 @@ from __future__ import annotations
 import numpy as np
 import pandas as pd
 
+from scdna_replication_tools_tpu.ops.stats import mode_int
+
 
 def add_cell_ploidies(
     cn: pd.DataFrame,
@@ -22,11 +24,8 @@ def add_cell_ploidies(
 ) -> pd.DataFrame:
     """Ploidy = modal CN state per cell (reference:
     compute_consensus_clone_profiles.py:30-39)."""
-    def _mode(s: pd.Series) -> float:
-        vals, counts = np.unique(s.to_numpy(), return_counts=True)
-        return float(vals[np.argmax(counts)])
-
-    ploidies = cn.groupby(cell_col, observed=True)[cn_state_col].agg(_mode)
+    ploidies = cn.groupby(cell_col, observed=True)[cn_state_col] \
+        .agg(lambda s: mode_int(s.to_numpy()))
     cn = cn.copy()
     cn[ploidy_col] = cn[cell_col].map(ploidies)
     return cn

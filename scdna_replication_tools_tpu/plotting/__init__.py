@@ -1,0 +1,25 @@
+from scdna_replication_tools_tpu.plotting.utils import (
+    get_clone_cmap,
+    get_cn_cmap,
+    get_phase_cmap,
+    get_rt_cmap,
+    plot_cell_cn_profile,
+    plot_clustered_cell_cn_matrix,
+)
+from scdna_replication_tools_tpu.plotting.pert_output import (
+    plot_cn_states,
+    plot_model_results,
+    plot_rpm,
+)
+
+__all__ = [
+    "get_clone_cmap",
+    "get_cn_cmap",
+    "get_phase_cmap",
+    "get_rt_cmap",
+    "plot_cell_cn_profile",
+    "plot_clustered_cell_cn_matrix",
+    "plot_cn_states",
+    "plot_model_results",
+    "plot_rpm",
+]

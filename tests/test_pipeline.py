@@ -71,6 +71,23 @@ def test_spf_fractions(synthetic_frames):
     assert (out["SPF_std"] > 0).all()
 
 
+def test_binarize_without_chr_column():
+    """Regression: chr-less input must binarise, not silently empty out."""
+    from scdna_replication_tools_tpu.pipeline.binarize import (
+        binarize_profiles,
+    )
+    rng = np.random.default_rng(0)
+    df = pd.DataFrame({
+        "cell_id": np.repeat([f"c{i}" for i in range(4)], 50),
+        "start": np.tile(np.arange(50), 4),
+        "rt_value": rng.normal(0, 1, 200),
+    })
+    out, manhattan = binarize_profiles(df, "rt_value")
+    assert len(out) == 200
+    assert set(out["rt_state"].unique()) <= {0.0, 1.0}
+    assert len(manhattan) == 400  # 4 cells x 100 thresholds
+
+
 def _phase_input():
     rng = np.random.default_rng(4)
     rows = []

@@ -1,0 +1,71 @@
+"""Plotting smoke tests (Agg backend) — figures render without scgenome."""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import matplotlib.pyplot as plt
+import numpy as np
+import pandas as pd
+import pytest
+
+from scdna_replication_tools_tpu.plotting import (
+    get_clone_cmap,
+    get_cn_cmap,
+    get_rt_cmap,
+    plot_cell_cn_profile,
+    plot_clustered_cell_cn_matrix,
+    plot_model_results,
+)
+
+
+@pytest.fixture(scope="module")
+def plot_frame():
+    rng = np.random.default_rng(0)
+    rows = []
+    for clone, cells in [("A", 6), ("B", 6)]:
+        for i in range(cells):
+            for chrom, n in [("1", 40), ("2", 30)]:
+                starts = np.arange(n) * 500_000
+                rows.append(pd.DataFrame({
+                    "cell_id": f"{clone}{i}",
+                    "chr": chrom,
+                    "start": starts,
+                    "end": starts + 500_000,
+                    "clone_id": clone,
+                    "state": 2 + (clone == "B") * (np.arange(n) < 10),
+                    "model_cn_state": 2,
+                    "model_rep_state": rng.integers(0, 2, n),
+                    "model_tau": (i + 1) / (cells + 1),
+                    "rpm": rng.poisson(50, n).astype(float),
+                }))
+    return pd.concat(rows, ignore_index=True)
+
+
+def test_cmaps():
+    assert get_cn_cmap(np.array([0, 5])).N == 6
+    assert get_rt_cmap().N == 2
+    assert "A" in get_clone_cmap()
+
+
+def test_genome_profile_axis(plot_frame):
+    fig, ax = plt.subplots()
+    one_cell = plot_frame[plot_frame.cell_id == "A0"]
+    plot_cell_cn_profile(ax, one_cell, "rpm", cn_field_name="state",
+                         rawy=True)
+    assert ax.get_xlabel() == "chromosome"
+    plt.close(fig)
+
+
+def test_clustered_matrix_shapes(plot_frame):
+    fig, ax = plt.subplots()
+    mat = plot_clustered_cell_cn_matrix(ax, plot_frame, "state",
+                                        cluster_field_name="clone_id")
+    assert mat.shape == (70, 12)  # 70 loci x 12 cells
+    plt.close(fig)
+
+
+def test_plot_model_results_renders(plot_frame):
+    fig = plot_model_results(plot_frame, plot_frame)
+    assert len(fig.axes) >= 8
+    plt.close(fig)
