@@ -86,6 +86,11 @@ class PertConfig:
     num_shards: Optional[int] = 1
     # write checkpoints at step boundaries (step1/step2/step3) to this dir.
     checkpoint_dir: Optional[str] = None
+    # enumerated-likelihood implementation: 'auto' picks the fused Pallas
+    # kernel (ops/enum_kernel.py) on single-device TPU runs and the XLA
+    # broadcast path elsewhere; 'xla' / 'pallas' / 'pallas_interpret'
+    # force a specific path.
+    enum_impl: str = "auto"
 
     def resolved_iters(self) -> dict:
         """Step 1/3 budgets default to half of step 2's (pert_model.py:104-120)."""

@@ -112,6 +112,24 @@ class PertInference:
 
     # -- batches ----------------------------------------------------------
 
+    def _enum_impl(self) -> str:
+        """Resolve the 'auto' enumerated-likelihood implementation.
+
+        The fused Pallas kernel is single-device (it is not annotated for
+        partitioning), so 'auto' selects it only for unsharded TPU runs;
+        sharded runs and CPU use the XLA broadcast path, which partitions
+        and fuses fine under jit.
+        """
+        impl = self.config.enum_impl
+        if impl not in ("auto", "xla", "pallas", "pallas_interpret"):
+            raise ValueError(f"unknown enum_impl {impl!r}")
+        if impl != "auto":
+            return impl
+        on_tpu = jax.devices()[0].platform in ("tpu", "axon") or \
+            "TPU" in jax.devices()[0].device_kind
+        single = self._mesh is None or self._mesh.devices.size == 1
+        return "pallas" if (on_tpu and single) else "xla"
+
     def _gamma_feats(self) -> jnp.ndarray:
         return gc_features(jnp.asarray(self.s.gammas), self.config.K)
 
@@ -275,7 +293,8 @@ class PertInference:
         spec = PertModelSpec(
             P=self.config.P, K=self.config.K, L=self.L,
             tau_mode="param", step1=False, cond_beta_means=True,
-            fixed_lamb=True, cell_chunk=self.config.cell_chunk)
+            fixed_lamb=True, cell_chunk=self.config.cell_chunk,
+            enum_impl=self._enum_impl())
         out = self._fit(spec, batch, fixed, t_init,
                         iters["max_iter"], iters["min_iter"], "step2")
         self._step2_data = s
@@ -307,7 +326,8 @@ class PertInference:
             P=self.config.P, K=self.config.K, L=self.L,
             tau_mode="param", step1=False, cond_beta_means=True,
             cond_rho=True, cond_a=True, fixed_lamb=True,
-            cell_chunk=self.config.cell_chunk)
+            cell_chunk=self.config.cell_chunk,
+            enum_impl=self._enum_impl())
         out = self._fit(spec, batch, fixed, t_init2,
                         iters["max_iter_step3"], iters["min_iter_step3"],
                         "step3")

@@ -95,6 +95,11 @@ class PertModelSpec:
     # lambda fixed as a plain argument (no site at all) — steps 2/3
     fixed_lamb: bool = False
     cell_chunk: Optional[int] = None
+    # enumerated-likelihood implementation: 'xla' (dense broadcast tensor,
+    # the fallback + parity oracle), 'pallas' (fused TPU kernel, see
+    # ops/enum_kernel.py) or 'pallas_interpret' (kernel via interpreter,
+    # CPU tests only)
+    enum_impl: str = "xla"
 
 
 class PertBatch:
@@ -309,6 +314,19 @@ def _joint_logits(P, reads, u, omega, log_pi, phi, lamb, log_lamb,
 def _enum_bin_loglik(spec, reads, u, omega, log_pi, phi, lamb, log_lamb,
                      log1m_lamb):
     """(cells, loci) enumerated bin log-likelihood (states summed out)."""
+    if spec.enum_impl in ("pallas", "pallas_interpret"):
+        # the kernel's custom VJP emits no lamb cotangent: only valid when
+        # lambda is fixed (it is, in every enumerated step — pert_model.py:801)
+        assert spec.fixed_lamb, (
+            "enum_impl='pallas' requires fixed_lamb=True: the fused kernel "
+            "does not differentiate through lambda")
+        from scdna_replication_tools_tpu.ops.enum_kernel import enum_loglik
+        mu = u[:, None] * omega
+        return enum_loglik(reads, mu, log_pi, phi, lamb,
+                           spec.enum_impl == "pallas_interpret")
+    if spec.enum_impl != "xla":
+        raise ValueError(f"unknown enum_impl {spec.enum_impl!r}; expected "
+                         "'xla', 'pallas' or 'pallas_interpret'")
     joint = _joint_logits(spec.P, reads, u, omega, log_pi, phi, lamb,
                           log_lamb, log1m_lamb)
     return logsumexp(joint, axis=(-2, -1))

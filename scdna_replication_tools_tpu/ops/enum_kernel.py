@@ -1,0 +1,273 @@
+"""Fused Pallas TPU kernel for the enumerated PERT bin log-likelihood.
+
+The training objective marginalises the two discrete latents — CN state
+(P=13) and replication state (2) — of every (cell, locus) bin
+(reference: pert_model.py:611-646).  Expressed naively that is a
+``(cells, loci, P, 2)`` tensor: 26x the data size, ~0.5 GB at the
+1k-cell x 5.4k-bin genome-wide workload, and reverse-mode AD wants to
+park it (plus several gammaln intermediates) in HBM as residuals.  HBM
+traffic, not FLOPs, then dominates every SVI iteration.
+
+This module computes
+
+    ll[c, l] = logsumexp_{s in 0..P-1, r in 0,1}(
+                   log_pi[c, l, s]
+                 + log Bernoulli(r | phi[c, l])
+                 + log NB(reads[c, l] | delta(mu[c, l], s, r), lamb))
+
+    delta(mu, s, r) = max(mu * s * (1 + r) * (1 - lamb) / lamb, 1)
+
+as one Pallas kernel over (cells, loci) tiles: the 26-way state product
+lives in VMEM registers of an online logsumexp, and only the (cells, loci)
+result ever touches HBM.  The backward pass is a second kernel that
+*recomputes* the state logits from the same inputs and directly emits
+dmu, dlog_pi, dphi — the classic flash-attention trade: 2x the
+transcendental FLOPs, zero enumeration-tensor HBM traffic in either pass.
+
+State-independent terms are hoisted out of the 26-state loop:
+
+    ll = logsumexp_{s,r}(log_pi_s + bern_r + lgamma(x + delta_sr)
+                         - lgamma(delta_sr) + delta_sr * log(1 - lamb))
+         + x * log(lamb) - lgamma(x + 1)
+
+Layout: ``log_pi`` is consumed as (P, cells, loci) so each state slice is
+a well-tiled (tc, tl) block (P=13 would be a terrible minor-most dim).
+
+The XLA reference path (``models.pert._enum_bin_loglik``) remains the
+fallback for CPU and the parity oracle in tests (``interpret=True`` runs
+this same kernel through the Pallas interpreter on CPU).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# default tile sizes: lane dim 512 amortises control overhead, sublane 8
+# matches the f32 tile; (8, 512) x ~30 live buffers stays far under VMEM
+TILE_C = 8
+TILE_L = 512
+
+_HALF_LOG_2PI = 0.9189385332046727
+
+
+def _lgamma_ge1(z):
+    """float32 log-Gamma for z >= 1 (Mosaic has no lgamma primitive).
+
+    Stirling's series is accurate past z ~ 8; smaller arguments are shifted
+    up by 8 with the recurrence lgamma(z) = lgamma(z+8) - log(prod(z+i)).
+    The product is evaluated at min(z, 8) so it cannot overflow when z is
+    large (the branch that would use it is then discarded by the select).
+    Max observed error vs scipy on [1, 1e7]: < 3e-6 relative.
+    """
+    zs = jnp.minimum(z, 8.0)
+    shift_prod = (zs * (zs + 1.0) * (zs + 2.0) * (zs + 3.0)
+                  * (zs + 4.0) * (zs + 5.0) * (zs + 6.0) * (zs + 7.0))
+    zz = jnp.where(z < 8.0, z + 8.0, z)
+    inv = 1.0 / zz
+    inv2 = inv * inv
+    series = inv * (1.0 / 12.0 + inv2 * (-1.0 / 360.0 + inv2 * (1.0 / 1260.0)))
+    st = (zz - 0.5) * jnp.log(zz) - zz + _HALF_LOG_2PI + series
+    return jnp.where(z < 8.0, st - jnp.log(shift_prod), st)
+
+
+def _digamma_ge1(z):
+    """float32 digamma for z >= 1 (asymptotic series + 8-step recurrence)."""
+    zs = jnp.minimum(z, 8.0)
+    shift_sum = (1.0 / zs + 1.0 / (zs + 1.0) + 1.0 / (zs + 2.0)
+                 + 1.0 / (zs + 3.0) + 1.0 / (zs + 4.0) + 1.0 / (zs + 5.0)
+                 + 1.0 / (zs + 6.0) + 1.0 / (zs + 7.0))
+    zz = jnp.where(z < 8.0, z + 8.0, z)
+    inv = 1.0 / zz
+    inv2 = inv * inv
+    psi = (jnp.log(zz) - 0.5 * inv
+           - inv2 * (1.0 / 12.0 + inv2 * (-1.0 / 120.0 + inv2 * (1.0 / 252.0))))
+    return jnp.where(z < 8.0, psi - shift_sum, psi)
+
+
+def _nb_core(x, mu, chi, q, log1m_lamb):
+    """State-dependent part of the NB log-pmf (see module docstring)."""
+    delta = jnp.maximum(mu * (chi * q), 1.0)
+    return (_lgamma_ge1(x + delta) - _lgamma_ge1(delta)
+            + delta * log1m_lamb), delta
+
+
+def _fwd_kernel(scal_ref, reads_ref, mu_ref, phi_ref, log_pi_ref, out_ref,
+                *, P):
+    log_lamb = scal_ref[0, 0]
+    log1m_lamb = scal_ref[0, 1]
+    q = scal_ref[0, 2]
+
+    x = reads_ref[...]
+    mu = mu_ref[...]
+    phi = phi_ref[...]
+    bern0 = jnp.log1p(-phi)
+    bern1 = jnp.log(phi)
+
+    neg_inf = jnp.full_like(x, -jnp.inf)
+
+    def body(s, carry):
+        m, acc = carry
+        lp = log_pi_ref[s]
+        chi = s.astype(jnp.float32)
+        for bern, mult in ((bern0, 1.0), (bern1, 2.0)):
+            nb, _ = _nb_core(x, mu, chi * mult, q, log1m_lamb)
+            j = lp + bern + nb
+            m_new = jnp.maximum(m, j)
+            acc = acc * jnp.exp(m - m_new) + jnp.exp(j - m_new)
+            m = m_new
+        return m, acc
+
+    m, acc = jax.lax.fori_loop(0, P, body, (neg_inf, jnp.zeros_like(x)))
+    out_ref[...] = (m + jnp.log(acc)
+                    + x * log_lamb - _lgamma_ge1(x + 1.0))
+
+
+def _bwd_kernel(scal_ref, reads_ref, mu_ref, phi_ref, log_pi_ref, ll_ref,
+                g_ref, dmu_ref, dphi_ref, dlog_pi_ref, *, P):
+    log_lamb = scal_ref[0, 0]
+    log1m_lamb = scal_ref[0, 1]
+    q = scal_ref[0, 2]
+
+    x = reads_ref[...]
+    mu = mu_ref[...]
+    phi = phi_ref[...]
+    g = g_ref[...]
+    # subtract the hoisted state-independent terms so that
+    # w = exp(j_state - ll_state) normalises over the 26 states
+    ll_state = ll_ref[...] - (x * log_lamb - _lgamma_ge1(x + 1.0))
+    bern0 = jnp.log1p(-phi)
+    bern1 = jnp.log(phi)
+    inv_phi = 1.0 / phi
+    inv_1m_phi = 1.0 / (1.0 - phi)
+
+    def body(s, carry):
+        dmu, dphi = carry
+        lp = log_pi_ref[s]
+        chi = s.astype(jnp.float32)
+        dlp = jnp.zeros_like(x)
+        for bern, dbern, mult in ((bern0, -inv_1m_phi, 1.0),
+                                  (bern1, inv_phi, 2.0)):
+            chi_r = chi * mult
+            nb, delta = _nb_core(x, mu, chi_r, q, log1m_lamb)
+            w = jnp.exp(lp + bern + nb - ll_state)
+            gw = g * w
+            # d nb / d delta, gated on the delta > 1 clamp region
+            ddelta = (_digamma_ge1(x + delta) - _digamma_ge1(delta)
+                      + log1m_lamb)
+            active = (mu * (chi_r * q) > 1.0).astype(jnp.float32)
+            dmu = dmu + gw * ddelta * active * (chi_r * q)
+            dphi = dphi + gw * dbern
+            dlp = dlp + gw
+        dlog_pi_ref[s] = dlp
+        return dmu, dphi
+
+    dmu, dphi = jax.lax.fori_loop(
+        0, P, body, (jnp.zeros_like(x), jnp.zeros_like(x)))
+    dmu_ref[...] = dmu
+    dphi_ref[...] = dphi
+
+
+def _pad2(x, tc, tl, value):
+    c, l = x.shape[-2], x.shape[-1]
+    pc = (-c) % tc
+    pll = (-l) % tl
+    if pc == 0 and pll == 0:
+        return x
+    pad = [(0, 0)] * (x.ndim - 2) + [(0, pc), (0, pll)]
+    return jnp.pad(x, pad, constant_values=value)
+
+
+def _grid_specs(P, nc, nl):
+    cl = pl.BlockSpec((TILE_C, TILE_L), lambda i, j: (i, j))
+    pcl = pl.BlockSpec((P, TILE_C, TILE_L), lambda i, j: (0, i, j))
+    scal = pl.BlockSpec(memory_space=pltpu.SMEM)
+    layout = {"scal": scal, "cl": cl, "pcl": pcl}
+    return layout, (nc // TILE_C, nl // TILE_L)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def enum_loglik(reads, mu, log_pi, phi, lamb, interpret=False):
+    """(cells, loci) enumerated bin log-likelihood, Pallas-fused.
+
+    ``log_pi`` is (cells, loci, P); ``lamb`` is a scalar (no gradient —
+    lambda is fixed in the enumerated steps, reference: pert_model.py:801).
+    """
+    ll, _ = _enum_fwd(reads, mu, log_pi, phi, lamb, interpret)
+    return ll
+
+
+def _scalars(lamb):
+    lamb = jnp.asarray(lamb, jnp.float32).reshape(())
+    return jnp.stack([jnp.log(lamb), jnp.log1p(-lamb),
+                      (1.0 - lamb) / lamb]).reshape(1, 3)
+
+
+def _enum_fwd(reads, mu, log_pi, phi, lamb, interpret):
+    C, L = reads.shape
+    P = log_pi.shape[-1]
+    scal = _scalars(lamb)
+
+    log_pi_t = jnp.transpose(log_pi, (2, 0, 1))
+    reads_p = _pad2(reads, TILE_C, TILE_L, 0.0)
+    mu_p = _pad2(mu, TILE_C, TILE_L, 1.0)
+    phi_p = _pad2(phi, TILE_C, TILE_L, 0.5)
+    log_pi_p = _pad2(log_pi_t, TILE_C, TILE_L, 0.0)
+    nc, nl = reads_p.shape
+
+    lay, grid = _grid_specs(P, nc, nl)
+    ll = pl.pallas_call(
+        functools.partial(_fwd_kernel, P=P),
+        grid=grid,
+        in_specs=[lay["scal"], lay["cl"], lay["cl"], lay["cl"], lay["pcl"]],
+        out_specs=lay["cl"],
+        out_shape=jax.ShapeDtypeStruct((nc, nl), jnp.float32),
+        interpret=interpret,
+    )(scal, reads_p, mu_p, phi_p, log_pi_p)
+    ll = ll[:C, :L]
+    return ll, (reads, mu, log_pi, phi, lamb, ll)
+
+
+def _enum_bwd(interpret, res, g):
+    reads, mu, log_pi, phi, lamb, ll = res
+    C, L = reads.shape
+    P = log_pi.shape[-1]
+    scal = _scalars(lamb)
+
+    log_pi_t = jnp.transpose(log_pi, (2, 0, 1))
+    reads_p = _pad2(reads, TILE_C, TILE_L, 0.0)
+    mu_p = _pad2(mu, TILE_C, TILE_L, 1.0)
+    phi_p = _pad2(phi, TILE_C, TILE_L, 0.5)
+    log_pi_p = _pad2(log_pi_t, TILE_C, TILE_L, 0.0)
+    ll_p = _pad2(ll, TILE_C, TILE_L, 0.0)
+    g_p = _pad2(g, TILE_C, TILE_L, 0.0)
+    nc, nl = reads_p.shape
+
+    lay, grid = _grid_specs(P, nc, nl)
+    dmu, dphi, dlog_pi_t = pl.pallas_call(
+        functools.partial(_bwd_kernel, P=P),
+        grid=grid,
+        in_specs=[lay["scal"], lay["cl"], lay["cl"], lay["cl"], lay["pcl"],
+                  lay["cl"], lay["cl"]],
+        out_specs=[lay["cl"], lay["cl"], lay["pcl"]],
+        out_shape=[
+            jax.ShapeDtypeStruct((nc, nl), jnp.float32),
+            jax.ShapeDtypeStruct((nc, nl), jnp.float32),
+            jax.ShapeDtypeStruct((P, nc, nl), jnp.float32),
+        ],
+        interpret=interpret,
+    )(scal, reads_p, mu_p, phi_p, log_pi_p, ll_p, g_p)
+
+    dmu = dmu[:C, :L]
+    dphi = dphi[:C, :L]
+    dlog_pi = jnp.transpose(dlog_pi_t[:, :C, :L], (1, 2, 0))
+    return (jnp.zeros_like(reads), dmu, dlog_pi, dphi,
+            jnp.zeros_like(jnp.asarray(lamb)))
+
+
+enum_loglik.defvjp(lambda r, m, lp, p, la, i: _enum_fwd(r, m, lp, p, la, i),
+                   _enum_bwd)
