@@ -38,7 +38,7 @@ def _problem(num_cells, num_loci, P, K, seed=0):
     return reads, gammas, etas, t_init
 
 
-def bench_jax(num_cells, num_loci, P, K, iters):
+def bench_jax(num_cells, num_loci, P, K, iters, enum_impl="auto"):
     import jax
     import jax.numpy as jnp
     import optax
@@ -51,9 +51,13 @@ def bench_jax(num_cells, num_loci, P, K, iters):
     )
     from scdna_replication_tools_tpu.ops.gc import gc_features
 
+    from scdna_replication_tools_tpu.ops.enum_kernel import resolve_enum_impl
+    enum_impl = resolve_enum_impl(enum_impl)
+
     reads, gammas, etas, t_init = _problem(num_cells, num_loci, P, K)
     spec = PertModelSpec(P=P, K=K, L=1, tau_mode="param",
-                         cond_beta_means=True, fixed_lamb=True)
+                         cond_beta_means=True, fixed_lamb=True,
+                         enum_impl=enum_impl)
     batch = PertBatch(
         reads=jnp.asarray(reads),
         libs=jnp.zeros((num_cells,), jnp.int32),
@@ -205,10 +209,12 @@ def main():
     ap.add_argument("--iters", type=int, default=100)
     ap.add_argument("--baseline-iters", type=int, default=3)
     ap.add_argument("--skip-baseline", action="store_true")
+    ap.add_argument("--enum-impl", default="auto",
+                    choices=["auto", "xla", "pallas", "pallas_interpret"])
     args = ap.parse_args()
 
     jax_per_iter, _ = bench_jax(args.cells, args.loci, args.P, args.K,
-                                args.iters)
+                                args.iters, enum_impl=args.enum_impl)
     cells_per_sec = args.cells / jax_per_iter
 
     if args.skip_baseline:

@@ -50,7 +50,8 @@ class scRT:
                  cn_prior_weight=1e6, learning_rate=0.05, rel_tol=1e-6,
                  cuda=False, seed=0, P=13, K=4, J=5, upsilon=6,
                  run_step3=True, backend='jax', num_shards=1,
-                 cell_chunk=None, checkpoint_dir=None):
+                 cell_chunk=None, checkpoint_dir=None, enum_impl='auto',
+                 cn_hmm_self_prob=None):
         self.cn_s = cn_s
         self.cn_g1 = cn_g1
         self.clone_col = clone_col
@@ -72,7 +73,8 @@ class scRT:
             min_iter_step1=min_iter_step1, max_iter_step3=max_iter_step3,
             min_iter_step3=min_iter_step3, run_step3=run_step3, seed=seed,
             num_shards=num_shards, cell_chunk=cell_chunk,
-            checkpoint_dir=checkpoint_dir,
+            checkpoint_dir=checkpoint_dir, enum_impl=enum_impl,
+            cn_hmm_self_prob=cn_hmm_self_prob,
         )
 
         self.clone_profiles = None
@@ -156,12 +158,14 @@ class scRT:
 
         cn_s_out, supp_s_out = package_step_output(
             self.cn_s, inference._step2_data, step2, lamb,
-            step1.fit.losses, step2.fit.losses, cols)
+            step1.fit.losses, step2.fit.losses, cols,
+            hmm_self_prob=self.config.cn_hmm_self_prob)
 
         if step3 is not None:
             cn_g1_out, supp_g1_out = package_step_output(
                 self.cn_g1, inference._step3_data, step3, lamb,
-                step1.fit.losses, step3.fit.losses, cols)
+                step1.fit.losses, step3.fit.losses, cols,
+                hmm_self_prob=self.config.cn_hmm_self_prob)
         else:
             cn_g1_out, supp_g1_out = None, None
 

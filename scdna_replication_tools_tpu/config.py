@@ -87,10 +87,18 @@ class PertConfig:
     # write checkpoints at step boundaries (step1/step2/step3) to this dir.
     checkpoint_dir: Optional[str] = None
     # enumerated-likelihood implementation: 'auto' picks the fused Pallas
-    # kernel (ops/enum_kernel.py) on single-device TPU runs and the XLA
-    # broadcast path elsewhere; 'xla' / 'pallas' / 'pallas_interpret'
-    # force a specific path.
+    # kernel (ops/enum_kernel.py) on TPU (shard_map'd per device when a
+    # mesh is active) and the XLA broadcast path elsewhere; 'xla' /
+    # 'pallas' / 'pallas_interpret' force a specific path.
     enum_impl: str = "auto"
+    # write jax.profiler traces (TensorBoard/Perfetto) of each SVI step
+    # fit into this directory; None disables tracing.
+    profile_dir: Optional[str] = None
+    # optional genome-smoothed CN decode: Viterbi over loci with this
+    # self-transition probability (the transition matrix the reference
+    # defines but never uses, pert_model.py:260-269); None keeps the
+    # reference's independent per-bin argmax decode.
+    cn_hmm_self_prob: Optional[float] = None
 
     def resolved_iters(self) -> dict:
         """Step 1/3 budgets default to half of step 2's (pert_model.py:104-120)."""

@@ -48,6 +48,7 @@ from scdna_replication_tools_tpu.models.pert import (
 )
 from scdna_replication_tools_tpu.ops.gc import gc_features
 from scdna_replication_tools_tpu.ops.stats import guess_times, pearson_matrix
+from scdna_replication_tools_tpu.utils import profiling
 from scdna_replication_tools_tpu.parallel.mesh import (
     make_mesh,
     shard_batch,
@@ -113,22 +114,14 @@ class PertInference:
     # -- batches ----------------------------------------------------------
 
     def _enum_impl(self) -> str:
-        """Resolve the 'auto' enumerated-likelihood implementation.
-
-        The fused Pallas kernel is single-device (it is not annotated for
-        partitioning), so 'auto' selects it only for unsharded TPU runs;
-        sharded runs and CPU use the XLA broadcast path, which partitions
-        and fuses fine under jit.
-        """
-        impl = self.config.enum_impl
-        if impl not in ("auto", "xla", "pallas", "pallas_interpret"):
-            raise ValueError(f"unknown enum_impl {impl!r}")
-        if impl != "auto":
-            return impl
-        on_tpu = jax.devices()[0].platform in ("tpu", "axon") or \
-            "TPU" in jax.devices()[0].device_kind
-        single = self._mesh is None or self._mesh.devices.size == 1
-        return "pallas" if (on_tpu and single) else "xla"
+        """Resolve the 'auto' enumerated-likelihood implementation
+        (shared policy: ops.enum_kernel.resolve_enum_impl).  When a mesh
+        is active the Pallas kernel runs per-device via shard_map — see
+        models.pert._enum_bin_loglik."""
+        from scdna_replication_tools_tpu.ops.enum_kernel import (
+            resolve_enum_impl,
+        )
+        return resolve_enum_impl(self.config.enum_impl)
 
     def _gamma_feats(self) -> jnp.ndarray:
         return gc_features(jnp.asarray(self.s.gammas), self.config.K)
@@ -243,16 +236,22 @@ class PertInference:
 
         params0 = init_params(spec, batch, fixed, t_init=t_init)
         batch, params0 = self._maybe_shard(batch, params0)
+        mesh = self._mesh if spec.enum_impl in ("pallas",
+                                                "pallas_interpret") else None
 
         def loss_fn(params, fixed, batch):
-            return pert_loss(spec, params, fixed, batch)
+            return pert_loss(spec, params, fixed, batch, mesh=mesh)
 
         t0 = time.perf_counter()
-        fit = fit_map(loss_fn, params0, (fixed, batch),
-                      max_iter=max_iter, min_iter=min_iter,
-                      rel_tol=cfg.rel_tol, learning_rate=cfg.learning_rate,
-                      b1=cfg.adam_b1, b2=cfg.adam_b2)
+        with profiling.trace(cfg.profile_dir):
+            fit = fit_map(loss_fn, params0, (fixed, batch),
+                          max_iter=max_iter, min_iter=min_iter,
+                          rel_tol=cfg.rel_tol,
+                          learning_rate=cfg.learning_rate,
+                          b1=cfg.adam_b1, b2=cfg.adam_b2)
         wall = time.perf_counter() - t0
+        profiling.log_step_summary(step_name, fit, wall,
+                                   int(batch.reads.shape[0]))
 
         if cfg.checkpoint_dir:
             ckpt.save_step(cfg.checkpoint_dir, step_name,
@@ -357,6 +356,7 @@ def package_step_output(
     losses_g: np.ndarray,
     losses_s: np.ndarray,
     cols: ColumnConfig = ColumnConfig(),
+    hmm_self_prob: Optional[float] = None,
 ) -> Tuple[pd.DataFrame, pd.DataFrame]:
     """Decode discretes + melt fitted values back to the long-form contract.
 
@@ -364,9 +364,21 @@ def package_step_output(
     model_cn_state, model_rep_state, model_tau, model_u, model_rho columns
     to ``cn_long`` and builds the supplementary param/loss table
     (model_lambda, model_a, loss_g, loss_s).
+
+    ``hmm_self_prob`` switches the per-bin argmax decode for the
+    genome-smoothed Viterbi CN decode (models/hmm.py) with that
+    self-transition probability.
     """
     spec, params, fixed, batch = step.spec, step.fit.params, step.fixed, step.batch
-    cn_map, rep_map, p_rep = decode_discrete(spec, params, fixed, batch)
+    if hmm_self_prob is not None:
+        from scdna_replication_tools_tpu.models.pert import decode_discrete_hmm
+        chroms = data.loci.get_level_values(0)
+        restart = jnp.asarray(
+            np.r_[1.0, (chroms[1:] != chroms[:-1]).astype(np.float32)])
+        cn_map, rep_map, p_rep = decode_discrete_hmm(
+            spec, params, fixed, batch, restart, hmm_self_prob)
+    else:
+        cn_map, rep_map, p_rep = decode_discrete(spec, params, fixed, batch)
     c = constrained(spec, params, fixed)
 
     n = int(np.sum(data.cell_mask)) if data.cell_mask is not None \

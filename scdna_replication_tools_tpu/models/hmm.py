@@ -1,0 +1,98 @@
+"""Locus-coupled CN decoding: Viterbi over the genome as a batched scan.
+
+The reference *declares* an HMM transition matrix for the CN chain —
+``build_trans_mat`` with self-probability ``t`` and uniform off-diagonal
+mass (reference: pert_model.py:260-269) — but never calls it: its decode
+is an independent per-bin argmax.  This module ships the feature the
+reference left dead, as an opt-in alternative decode that smooths
+single-bin CN flickers with a genome-aware Viterbi pass:
+
+* emissions are the same per-bin joint logits the independent decode
+  uses (models/pert._joint_logits), reduced over the replication axis, so
+  the two decodes never disagree about the model;
+* the transition matrix is the reference's intended one: log t on the
+  diagonal, log((1-t)/(P-1)) elsewhere;
+* chromosome boundaries break the chain (free transition), since
+  adjacent bins on different chromosomes are not physically adjacent;
+* the recursion is a ``lax.scan`` over loci vmapped over cells — the
+  (cells, P, P) transition step is a dense batched max-plus product, and
+  the whole decode is one compiled program.
+
+Replication states are then re-decoded *conditionally* on the Viterbi CN
+path (argmax over the rep axis at the chosen CN), keeping cn/rep jointly
+consistent.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def transition_log_probs(P: int, self_prob: float) -> jnp.ndarray:
+    """(P, P) log transition matrix: stay with ``self_prob``, switch
+    uniformly otherwise (the reference's intended trans_mat,
+    reference: pert_model.py:260-269)."""
+    off = (1.0 - self_prob) / (P - 1)
+    t = jnp.full((P, P), jnp.log(off), jnp.float32)
+    return t.at[jnp.arange(P), jnp.arange(P)].set(jnp.log(self_prob))
+
+
+def _viterbi_single(emissions: jnp.ndarray, restart: jnp.ndarray,
+                    log_trans: jnp.ndarray) -> jnp.ndarray:
+    """MAP state path for one cell.
+
+    emissions: (loci, P) log p(obs | state); restart: (loci,) 1.0 where a
+    new chromosome starts (free transition into that locus).
+    """
+    def fwd(carry, inp):
+        emit, is_restart = inp
+        # max-plus transition; a restart zeroes the transition scores so
+        # the chain re-initialises from the running path maximum
+        scores = carry[:, None] + jnp.where(is_restart, 0.0, log_trans)
+        best_prev = jnp.argmax(scores, axis=0)
+        best = jnp.max(scores, axis=0) + emit
+        return best, best_prev
+
+    init = emissions[0]
+    last, backptr = jax.lax.scan(fwd, init, (emissions[1:], restart[1:]))
+
+    last_state = jnp.argmax(last)
+
+    def back(state, bp):
+        prev = bp[state]
+        return prev, prev
+
+    _, path_rev = jax.lax.scan(back, last_state, backptr, reverse=True)
+    return jnp.concatenate([path_rev, last_state[None]]).astype(jnp.int32)
+
+
+def viterbi_paths(emissions: jnp.ndarray, restart: jnp.ndarray,
+                  log_trans: jnp.ndarray) -> jnp.ndarray:
+    """(cells, loci) MAP paths; emissions (cells, loci, P)."""
+    return jax.vmap(_viterbi_single, in_axes=(0, None, None))(
+        emissions, restart, log_trans)
+
+
+def hmm_decode(joint_logits: jnp.ndarray, restart: jnp.ndarray,
+               self_prob: float):
+    """Genome-smoothed (cn, rep, p_rep) from (cells, loci, P, 2) logits.
+
+    CN comes from Viterbi over the rep-marginalised emissions; rep is the
+    argmax over the rep axis *at the decoded CN*; p_rep stays the full
+    marginal P(rep=1 | reads) (identical to the independent decode —
+    shared helper in models/pert.py).
+    """
+    from jax.scipy.special import logsumexp
+
+    from scdna_replication_tools_tpu.models.pert import p_rep_marginal
+
+    P = joint_logits.shape[-2]
+    emissions = logsumexp(joint_logits, axis=-1)          # (c, l, P)
+    log_trans = transition_log_probs(P, self_prob)
+    cn_map = viterbi_paths(emissions, restart, log_trans)
+
+    at_cn = jnp.take_along_axis(
+        joint_logits, cn_map[..., None, None], axis=-2)[..., 0, :]  # (c, l, 2)
+    rep_map = jnp.argmax(at_cn, axis=-1).astype(jnp.int32)
+    return cn_map, rep_map, p_rep_marginal(joint_logits)

@@ -43,6 +43,7 @@ Site-type semantics preserved from the reference (they affect the loss):
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional
 
 import jax
@@ -312,18 +313,41 @@ def _joint_logits(P, reads, u, omega, log_pi, phi, lamb, log_lamb,
 
 
 def _enum_bin_loglik(spec, reads, u, omega, log_pi, phi, lamb, log_lamb,
-                     log1m_lamb):
-    """(cells, loci) enumerated bin log-likelihood (states summed out)."""
+                     log1m_lamb, mesh=None):
+    """(cells, loci) enumerated bin log-likelihood (states summed out).
+
+    When ``mesh`` is given and the Pallas implementation is selected, the
+    kernel runs under ``shard_map`` over the mesh's cells axis: each
+    device invokes the kernel on its local (cells/n, loci) shard — the op
+    is pointwise over cells, so no collectives are needed and the output
+    keeps the input sharding.
+    """
     if spec.enum_impl in ("pallas", "pallas_interpret"):
-        # the kernel's custom VJP emits no lamb cotangent: only valid when
-        # lambda is fixed (it is, in every enumerated step — pert_model.py:801)
-        assert spec.fixed_lamb, (
-            "enum_impl='pallas' requires fixed_lamb=True: the fused kernel "
-            "does not differentiate through lambda")
+        if not spec.fixed_lamb:
+            # the kernel's custom VJP emits no lamb cotangent: only valid
+            # when lambda is fixed (it is, in every enumerated step —
+            # pert_model.py:801)
+            raise ValueError(
+                "enum_impl='pallas' requires fixed_lamb=True: the fused "
+                "kernel does not differentiate through lambda")
         from scdna_replication_tools_tpu.ops.enum_kernel import enum_loglik
         mu = u[:, None] * omega
-        return enum_loglik(reads, mu, log_pi, phi, lamb,
-                           spec.enum_impl == "pallas_interpret")
+        interpret = spec.enum_impl == "pallas_interpret"
+        if mesh is None:
+            return enum_loglik(reads, mu, log_pi, phi, lamb, interpret)
+        from jax.sharding import PartitionSpec as PS
+        cells = mesh.axis_names[0]
+        fn = jax.shard_map(
+            functools.partial(enum_loglik, interpret=interpret),
+            mesh=mesh,
+            in_specs=(PS(cells, None), PS(cells, None),
+                      PS(cells, None, None), PS(cells, None), PS()),
+            out_specs=PS(cells, None),
+            # pallas_call's out_shape carries no varying-mesh-axes info;
+            # skip the vma check (the op is pointwise over cells)
+            check_vma=False,
+        )
+        return fn(reads, mu, log_pi, phi, lamb)
     if spec.enum_impl != "xla":
         raise ValueError(f"unknown enum_impl {spec.enum_impl!r}; expected "
                          "'xla', 'pallas' or 'pallas_interpret'")
@@ -345,7 +369,7 @@ def _observed_bin_loglik(spec, reads, u, omega, log_pi, phi, cn_obs, rep_obs,
 
 
 def log_joint(spec: PertModelSpec, params: dict, fixed: dict,
-              batch: PertBatch) -> jnp.ndarray:
+              batch: PertBatch, mesh=None) -> jnp.ndarray:
     """Total log-joint (the negative of the SVI loss), discretes summed out."""
     c = constrained(spec, params, fixed)
     lamb, log_lamb, log1m_lamb = _nb_pieces(c)
@@ -380,7 +404,7 @@ def log_joint(spec: PertModelSpec, params: dict, fixed: dict,
                                         cn_obs, rep_obs, lamb, log_lamb,
                                         log1m_lamb)
         return _enum_bin_loglik(spec, reads, u, omega_, log_pi_, phi_, lamb,
-                                log_lamb, log1m_lamb)
+                                log_lamb, log1m_lamb, mesh=mesh)
 
     if spec.cell_chunk is None:
         ll = bin_ll(batch.reads, c["u"], omega, log_pi, phi,
@@ -420,15 +444,40 @@ def log_joint(spec: PertModelSpec, params: dict, fixed: dict,
 
 
 def pert_loss(spec: PertModelSpec, params: dict, fixed: dict,
-              batch: PertBatch) -> jnp.ndarray:
+              batch: PertBatch, mesh=None) -> jnp.ndarray:
     """SVI loss = -ELBO = -log_joint (delta guide; matches the sign and
-    scale of the reference's ``svi.step`` losses, pert_model.py:742-758)."""
-    return -log_joint(spec, params, fixed, batch)
+    scale of the reference's ``svi.step`` losses, pert_model.py:742-758).
+
+    ``mesh`` (optional) routes the enumerated likelihood through
+    shard_map over the mesh's cells axis — see ``_enum_bin_loglik``."""
+    return -log_joint(spec, params, fixed, batch, mesh=mesh)
 
 
 # ---------------------------------------------------------------------------
 # discrete decode (infer_discrete, temperature=0)
 # ---------------------------------------------------------------------------
+
+def model_joint_logits(spec: PertModelSpec, params: dict, fixed: dict,
+                       batch: PertBatch) -> jnp.ndarray:
+    """(cells, loci, P, 2) joint logits of the fitted model — the shared
+    emission tensor of both decodes."""
+    c = constrained(spec, params, fixed)
+    lamb, log_lamb, log1m_lamb = _nb_pieces(c)
+    phi = _phi(c, batch.reads.shape[1])
+    omega = gc_rate(c["betas"], batch.gamma_feats)
+    return _joint_logits(spec.P, batch.reads, c["u"], omega, c["log_pi"],
+                         phi, lamb, log_lamb, log1m_lamb)
+
+
+def p_rep_marginal(joint: jnp.ndarray) -> jnp.ndarray:
+    """(cells, loci) posterior marginal P(rep=1 | reads) from the joint
+    logits — a capability the reference's temperature-0 decode does not
+    expose."""
+    P = joint.shape[-2]
+    flat = joint.reshape(joint.shape[:-2] + (P * 2,))
+    norm = logsumexp(flat, axis=-1)
+    return jnp.exp(logsumexp(joint[..., 1], axis=-1) - norm)
+
 
 def decode_discrete(spec: PertModelSpec, params: dict, fixed: dict,
                     batch: PertBatch):
@@ -440,25 +489,27 @@ def decode_discrete(spec: PertModelSpec, params: dict, fixed: dict,
     code, reference: pert_model.py:260-269), the joint MAP factorises into
     an independent argmax over the (P, 2) logits of each bin.
 
-    Returns (cn_map, rep_map, p_rep) each (cells, loci); p_rep is the
-    posterior marginal P(rep=1 | reads) — a capability the reference's
-    temperature-0 decode does not expose.
+    Returns (cn_map, rep_map, p_rep) each (cells, loci).
     """
-    c = constrained(spec, params, fixed)
-    lamb, log_lamb, log1m_lamb = _nb_pieces(c)
-    log_pi = c["log_pi"]
-    phi = _phi(c, batch.reads.shape[1])
-    omega = gc_rate(c["betas"], batch.gamma_feats)
-
-    P = spec.P
-    joint = _joint_logits(P, batch.reads, c["u"], omega, log_pi, phi, lamb,
-                          log_lamb, log1m_lamb)                  # (c, l, P, 2)
-
-    flat = joint.reshape(joint.shape[:-2] + (P * 2,))
+    joint = model_joint_logits(spec, params, fixed, batch)
+    flat = joint.reshape(joint.shape[:-2] + (spec.P * 2,))
     best = jnp.argmax(flat, axis=-1)
     cn_map = (best // 2).astype(jnp.int32)
     rep_map = (best % 2).astype(jnp.int32)
+    return cn_map, rep_map, p_rep_marginal(joint)
 
-    norm = logsumexp(flat, axis=-1)
-    p_rep = jnp.exp(logsumexp(joint[..., 1], axis=-1) - norm)
-    return cn_map, rep_map, p_rep
+
+def decode_discrete_hmm(spec: PertModelSpec, params: dict, fixed: dict,
+                        batch: PertBatch, restart: jnp.ndarray,
+                        self_prob: float):
+    """Genome-smoothed MAP decode: Viterbi over the CN chain.
+
+    Opt-in alternative to :func:`decode_discrete` that couples adjacent
+    loci with the transition matrix the reference defined but never used
+    (reference: pert_model.py:260-269) — see ``models.hmm``.  ``restart``
+    is a (loci,) float array with 1.0 wherever a new chromosome starts.
+    """
+    from scdna_replication_tools_tpu.models.hmm import hmm_decode
+
+    joint = model_joint_logits(spec, params, fixed, batch)
+    return hmm_decode(joint, restart, self_prob)

@@ -190,6 +190,37 @@ def _grid_specs(P, nc, nl):
     return layout, (nc // TILE_C, nl // TILE_L)
 
 
+def resolve_enum_impl(impl: str = "auto") -> str:
+    """Resolve the configured enumerated-likelihood implementation.
+
+    Single source of truth for the 'auto' policy (used by both the
+    inference runner and bench.py): the fused Pallas kernel on TPU, the
+    XLA broadcast path elsewhere.
+    """
+    if impl not in ("auto", "xla", "pallas", "pallas_interpret"):
+        raise ValueError(f"unknown enum_impl {impl!r}; expected 'auto', "
+                         "'xla', 'pallas' or 'pallas_interpret'")
+    if impl != "auto":
+        return impl
+    device = jax.devices()[0]
+    on_tpu = device.platform in ("tpu", "axon") or "TPU" in device.device_kind
+    return "pallas" if on_tpu else "xla"
+
+
+def _prep(reads, mu, log_pi, phi, lamb):
+    """Shared fwd/bwd input preamble: transpose log_pi to (P, c, l) and pad
+    to tile multiples.  The pad values are load-bearing: reads=0, mu=1,
+    phi=0.5 and log_pi=0 keep every padded-region term finite (the padded
+    outputs are sliced away, but NaN/inf would poison reductions)."""
+    scal = _scalars(lamb)
+    log_pi_t = jnp.transpose(log_pi, (2, 0, 1))
+    return (scal,
+            _pad2(reads, TILE_C, TILE_L, 0.0),
+            _pad2(mu, TILE_C, TILE_L, 1.0),
+            _pad2(phi, TILE_C, TILE_L, 0.5),
+            _pad2(log_pi_t, TILE_C, TILE_L, 0.0))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
 def enum_loglik(reads, mu, log_pi, phi, lamb, interpret=False):
     """(cells, loci) enumerated bin log-likelihood, Pallas-fused.
@@ -210,13 +241,7 @@ def _scalars(lamb):
 def _enum_fwd(reads, mu, log_pi, phi, lamb, interpret):
     C, L = reads.shape
     P = log_pi.shape[-1]
-    scal = _scalars(lamb)
-
-    log_pi_t = jnp.transpose(log_pi, (2, 0, 1))
-    reads_p = _pad2(reads, TILE_C, TILE_L, 0.0)
-    mu_p = _pad2(mu, TILE_C, TILE_L, 1.0)
-    phi_p = _pad2(phi, TILE_C, TILE_L, 0.5)
-    log_pi_p = _pad2(log_pi_t, TILE_C, TILE_L, 0.0)
+    scal, reads_p, mu_p, phi_p, log_pi_p = _prep(reads, mu, log_pi, phi, lamb)
     nc, nl = reads_p.shape
 
     lay, grid = _grid_specs(P, nc, nl)
@@ -236,13 +261,7 @@ def _enum_bwd(interpret, res, g):
     reads, mu, log_pi, phi, lamb, ll = res
     C, L = reads.shape
     P = log_pi.shape[-1]
-    scal = _scalars(lamb)
-
-    log_pi_t = jnp.transpose(log_pi, (2, 0, 1))
-    reads_p = _pad2(reads, TILE_C, TILE_L, 0.0)
-    mu_p = _pad2(mu, TILE_C, TILE_L, 1.0)
-    phi_p = _pad2(phi, TILE_C, TILE_L, 0.5)
-    log_pi_p = _pad2(log_pi_t, TILE_C, TILE_L, 0.0)
+    scal, reads_p, mu_p, phi_p, log_pi_p = _prep(reads, mu, log_pi, phi, lamb)
     ll_p = _pad2(ll, TILE_C, TILE_L, 0.0)
     g_p = _pad2(g, TILE_C, TILE_L, 0.0)
     nc, nl = reads_p.shape

@@ -1,0 +1,51 @@
+"""Profiling + observability hooks.
+
+The reference's only observability is a DEBUG logging stream with
+ms-resolution relative timestamps around every SVI step ("e.g. for
+profiling", reference: pert_model.py:25-33, 746, 804, 871).  The TPU
+framework replaces per-iteration host logging (which would serialise the
+on-device ``lax.while_loop``) with:
+
+* per-step wall-clock + iteration counts on ``StepOutput`` /
+  ``FitResult`` (infer/runner.py, infer/svi.py) — the loss history is the
+  per-iteration record, recoverable from the supplementary output table
+  exactly like the reference's log stream;
+* optional XLA-level traces via :func:`trace` — a ``jax.profiler``
+  context producing TensorBoard/Perfetto dumps of the compiled programs,
+  enabled with ``PertConfig(profile_dir=...)``;
+* :func:`log_step_summary` — one INFO line per SVI step with wall time,
+  iterations, throughput and convergence flags.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+
+logger = logging.getLogger("scdna_replication_tools_tpu")
+
+
+@contextlib.contextmanager
+def trace(profile_dir=None):
+    """jax.profiler trace context; no-op when ``profile_dir`` is None."""
+    if profile_dir is None:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(str(profile_dir)):
+        yield
+
+
+def log_step_summary(step_name: str, fit, wall_time: float,
+                     num_cells: int) -> None:
+    """One-line per-step summary (the reference logs per-iteration loss,
+    reference: pert_model.py:746; here the losses array carries that)."""
+    iters = max(fit.num_iters, 1)
+    logger.info(
+        "%s: %d iters in %.2fs (%.1f iters/s, %.0f cells/s), "
+        "final loss %.6g, converged=%s nan_abort=%s",
+        step_name, fit.num_iters, wall_time, iters / max(wall_time, 1e-9),
+        num_cells * iters / max(wall_time, 1e-9),
+        float(fit.losses[-1]) if len(fit.losses) else float("nan"),
+        fit.converged, fit.nan_abort)

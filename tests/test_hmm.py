@@ -1,0 +1,95 @@
+"""Tests for the genome-smoothed Viterbi CN decode (models/hmm.py)."""
+
+import itertools
+
+import jax.numpy as jnp
+import numpy as np
+
+from scdna_replication_tools_tpu.models.hmm import (
+    hmm_decode,
+    transition_log_probs,
+    viterbi_paths,
+)
+
+
+def _brute_force_path(emissions, restart, log_trans):
+    """Exact MAP path by exhaustive enumeration (small problems only)."""
+    L, P = emissions.shape
+    best_score, best_path = -np.inf, None
+    for path in itertools.product(range(P), repeat=L):
+        score = emissions[0, path[0]]
+        for t in range(1, L):
+            score += emissions[t, path[t]]
+            if not restart[t]:
+                score += log_trans[path[t - 1], path[t]]
+        if score > best_score:
+            best_score, best_path = score, path
+    return np.array(best_path)
+
+
+def test_viterbi_matches_brute_force():
+    rng = np.random.default_rng(0)
+    P, L = 4, 7
+    emissions = rng.normal(0, 2, (L, P)).astype(np.float32)
+    restart = np.zeros(L, np.float32)
+    restart[0] = restart[4] = 1.0  # chromosome break mid-sequence
+    log_trans = np.asarray(transition_log_probs(P, 0.9))
+
+    got = np.asarray(viterbi_paths(
+        jnp.asarray(emissions)[None], jnp.asarray(restart),
+        jnp.asarray(log_trans)))[0]
+    want = _brute_force_path(emissions, restart, log_trans)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_viterbi_smooths_single_bin_flicker():
+    """A lone weak outlier bin inside a long CN=2 segment is smoothed,
+    while a strongly-supported multi-bin segment is kept."""
+    P, L = 5, 40
+    emissions = np.full((L, P), -10.0, np.float32)
+    emissions[:, 2] = -1.0                 # CN 2 everywhere
+    emissions[15, 2] = -2.0                # one flicker bin weakly
+    emissions[15, 4] = -1.5                # ... prefers CN 4
+    # real 5-bin CN 3 segment: per-bin gain 3.0 x 5 bins = 15 beats the
+    # two switch penalties (2 x log(0.0025/0.99) ~ -12)
+    emissions[25:30, 3] = 2.0
+    restart = np.zeros(L, np.float32)
+    restart[0] = 1.0
+
+    log_trans = transition_log_probs(P, 0.99)
+    path = np.asarray(viterbi_paths(
+        jnp.asarray(emissions)[None], jnp.asarray(restart), log_trans))[0]
+
+    assert path[15] == 2, "flicker should be smoothed to the segment CN"
+    assert (path[25:30] == 3).all(), "supported segment must survive"
+    assert (np.delete(path, np.r_[15, 25:30]) == 2).all()
+
+
+def test_restart_decouples_chromosomes():
+    """With an extreme self-prob the path is constant per chromosome but
+    free to jump at the boundary."""
+    P, L = 3, 10
+    emissions = np.zeros((L, P), np.float32)
+    emissions[:5, 0] = 2.0   # chr1 favours state 0
+    emissions[5:, 2] = 2.0   # chr2 favours state 2
+    restart = np.zeros(L, np.float32)
+    restart[0] = restart[5] = 1.0
+
+    log_trans = transition_log_probs(P, 0.9999)
+    path = np.asarray(viterbi_paths(
+        jnp.asarray(emissions)[None], jnp.asarray(restart), log_trans))[0]
+    assert (path[:5] == 0).all() and (path[5:] == 2).all()
+
+
+def test_hmm_decode_shapes_and_rep_consistency():
+    rng = np.random.default_rng(1)
+    C, L, P = 3, 20, 6
+    joint = jnp.asarray(rng.normal(0, 1, (C, L, P, 2)).astype(np.float32))
+    restart = jnp.asarray(np.r_[1.0, np.zeros(L - 1)].astype(np.float32))
+    cn, rep, p_rep = hmm_decode(joint, restart, 0.95)
+    assert cn.shape == rep.shape == p_rep.shape == (C, L)
+    # rep must be the argmax over the rep axis at the decoded CN
+    at_cn = np.take_along_axis(np.asarray(joint),
+                               np.asarray(cn)[..., None, None], axis=-2)
+    np.testing.assert_array_equal(np.asarray(rep), at_cn[..., 0, :].argmax(-1))
+    assert ((0.0 <= np.asarray(p_rep)) & (np.asarray(p_rep) <= 1.0)).all()
