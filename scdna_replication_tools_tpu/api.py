@@ -50,8 +50,8 @@ class scRT:
                  cn_prior_weight=1e6, learning_rate=0.05, rel_tol=1e-6,
                  cuda=False, seed=0, P=13, K=4, J=5, upsilon=6,
                  run_step3=True, backend='jax', num_shards=1,
-                 cell_chunk=None, checkpoint_dir=None, enum_impl='auto',
-                 cn_hmm_self_prob=None):
+                 loci_shards=1, cell_chunk=None, checkpoint_dir=None,
+                 enum_impl='auto', cn_hmm_self_prob=None):
         self.cn_s = cn_s
         self.cn_g1 = cn_g1
         self.clone_col = clone_col
@@ -72,7 +72,8 @@ class scRT:
             rel_tol=rel_tol, max_iter_step1=max_iter_step1,
             min_iter_step1=min_iter_step1, max_iter_step3=max_iter_step3,
             min_iter_step3=min_iter_step3, run_step3=run_step3, seed=seed,
-            num_shards=num_shards, cell_chunk=cell_chunk,
+            num_shards=num_shards, loci_shards=loci_shards,
+            cell_chunk=cell_chunk,
             checkpoint_dir=checkpoint_dir, enum_impl=enum_impl,
             cn_hmm_self_prob=cn_hmm_self_prob,
         )

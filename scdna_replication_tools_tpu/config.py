@@ -84,6 +84,10 @@ class PertConfig:
     # shard the cells axis over this many devices; 1 = single device,
     # None or 0 = use every local device.
     num_shards: Optional[int] = 1
+    # shard the loci axis over this many devices (2-D cells x loci mesh;
+    # total devices = num_shards * loci_shards).  For the long-genome
+    # regime (20kb bins); loci are padded + masked to shard evenly.
+    loci_shards: int = 1
     # write checkpoints at step boundaries (step1/step2/step3) to this dir.
     checkpoint_dir: Optional[str] = None
     # enumerated-likelihood implementation: 'auto' picks the fused Pallas

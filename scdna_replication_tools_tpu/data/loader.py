@@ -47,6 +47,7 @@ class PertData:
     loci: pd.MultiIndex          # MultiIndex of (chr, start)
     library_ids: List            # index -> library id string
     cell_mask: np.ndarray        # (num_cells,) bool
+    loci_mask: Optional[np.ndarray] = None   # (num_loci,) bool; None = all real
 
     @property
     def num_cells(self) -> int:
@@ -189,6 +190,7 @@ def build_pert_inputs(
             loci=loci,
             library_ids=library_ids,
             cell_mask=np.ones(len(cell_ids), dtype=bool),
+            loci_mask=np.ones(len(loci), dtype=bool),
         )
 
     return _make(s_reads, s_states, libs_s), _make(g1_reads, g1_states, libs_g1)
@@ -219,4 +221,48 @@ def pad_cells(data: PertData, multiple: int) -> PertData:
         libs=np.concatenate([data.libs, np.zeros(pad, data.libs.dtype)]),
         cell_ids=list(data.cell_ids) + [f"__pad_{i}__" for i in range(pad)],
         cell_mask=np.concatenate([data.cell_mask, np.zeros(pad, dtype=bool)]),
+    )
+
+
+def pad_loci(data: PertData, multiple: int) -> PertData:
+    """Pad the loci axis to a multiple of ``multiple`` with masked loci.
+
+    The loci analog of :func:`pad_cells`, for sharding the loci axis of a
+    2-D (cells x loci) mesh — the long-genome regime (20kb bins,
+    reference README.md:55-57 warns it is runtime/NaN-prone; here the
+    padded bins are masked out of every reduction instead).  Padded loci
+    get chr='__PAD__' index entries (dropped by the inner merge when
+    results are melted back to long form), neutral GC (0.45) and
+    mid-range RT prior (0.5).
+    """
+    n = data.num_loci
+    target = ((n + multiple - 1) // multiple) * multiple
+    if target == n:
+        return data
+    pad = target - n
+
+    def _pad_mat(x):
+        if x is None:
+            return None
+        return np.concatenate([x, np.ones((x.shape[0], pad), x.dtype)], axis=1)
+
+    def _pad_vec(x, value):
+        if x is None:
+            return None
+        return np.concatenate([x, np.full(pad, value, x.dtype)])
+
+    chrs = list(data.loci.get_level_values(0).astype(str)) + ["__PAD__"] * pad
+    starts = list(data.loci.get_level_values(1)) + list(range(pad))
+    loci = pd.MultiIndex.from_arrays([chrs, starts],
+                                     names=data.loci.names)
+    loci_mask = data.loci_mask if data.loci_mask is not None \
+        else np.ones(n, dtype=bool)
+    return dataclasses.replace(
+        data,
+        reads=_pad_mat(data.reads),
+        states=_pad_mat(data.states),
+        gammas=_pad_vec(data.gammas, 0.45),
+        rt_prior=_pad_vec(data.rt_prior, 0.5),
+        loci=loci,
+        loci_mask=np.concatenate([loci_mask, np.zeros(pad, dtype=bool)]),
     )

@@ -34,6 +34,7 @@ from scdna_replication_tools_tpu.data.loader import (
     PertData,
     build_pert_inputs,
     pad_cells,
+    pad_loci,
 )
 from scdna_replication_tools_tpu.infer import checkpoint as ckpt
 from scdna_replication_tools_tpu.infer.svi import FitResult, fit_map
@@ -56,19 +57,35 @@ from scdna_replication_tools_tpu.parallel.mesh import (
 )
 
 
-def _pad_etas(etas: np.ndarray, target_cells: int) -> np.ndarray:
-    """Pad the cells axis of an etas tensor with a diploid-concentrated
-    prior.  Padding with all-ones would make the ploidy guess (argmax of
-    etas) zero for the pad cells and NaN the masked loss (see
-    models/pert.py ``_cell_ploidies``); a concentrated diploid row keeps
-    every term finite while the mask zeroes its contribution."""
-    if etas.shape[0] == target_cells:
-        return etas
-    pad = target_cells - etas.shape[0]
-    pad_row = np.ones(etas.shape[1:], etas.dtype)
-    pad_row[..., min(2, etas.shape[-1] - 1)] = 100.0
-    return np.concatenate(
-        [etas, np.broadcast_to(pad_row, (pad,) + etas.shape[1:])], axis=0)
+def _pad_etas(etas: np.ndarray, target_cells: int,
+              target_loci: Optional[int] = None) -> np.ndarray:
+    """Pad the cells (and optionally loci) axes of an etas tensor with a
+    diploid-concentrated prior.  Padding with all-ones would make the
+    ploidy guess (argmax of etas) zero for the pad cells and NaN the
+    masked loss (see models/pert.py ``_cell_ploidies``); a concentrated
+    diploid row keeps every term finite while the masks zero its
+    contribution."""
+    P = etas.shape[-1]
+    dip = min(2, P - 1)
+    if target_loci is not None and etas.shape[1] < target_loci:
+        pad = target_loci - etas.shape[1]
+        pad_block = np.ones((etas.shape[0], pad, P), etas.dtype)
+        pad_block[..., dip] = 100.0
+        etas = np.concatenate([etas, pad_block], axis=1)
+    if etas.shape[0] < target_cells:
+        pad = target_cells - etas.shape[0]
+        pad_row = np.ones(etas.shape[1:], etas.dtype)
+        pad_row[..., dip] = 100.0
+        etas = np.concatenate(
+            [etas, np.broadcast_to(pad_row, (pad,) + etas.shape[1:])], axis=0)
+    return etas
+
+
+def _loci_mask_arr(data: PertData):
+    """(loci,) float mask for PertBatch, or None when all loci are real."""
+    if data.loci_mask is None:
+        return None
+    return jnp.asarray(data.loci_mask.astype(np.float32))
 
 
 @dataclasses.dataclass
@@ -105,11 +122,12 @@ class PertInference:
         self.num_clones = num_clones
         self.L = s_data.num_libraries
         self._mesh = None
+        ls = config.loci_shards
         if config.num_shards is None or config.num_shards == 0:
             # None/0 = use every local device
-            self._mesh = make_mesh()
-        elif config.num_shards > 1:
-            self._mesh = make_mesh(config.num_shards)
+            self._mesh = make_mesh(loci_shards=ls)
+        elif config.num_shards > 1 or ls > 1:
+            self._mesh = make_mesh(config.num_shards, loci_shards=ls)
 
     # -- batches ----------------------------------------------------------
 
@@ -123,8 +141,8 @@ class PertInference:
         )
         return resolve_enum_impl(self.config.enum_impl)
 
-    def _gamma_feats(self) -> jnp.ndarray:
-        return gc_features(jnp.asarray(self.s.gammas), self.config.K)
+    def _gamma_feats(self, data: PertData) -> jnp.ndarray:
+        return gc_features(jnp.asarray(data.gammas), self.config.K)
 
     def _maybe_shard(self, batch: PertBatch, params: dict):
         if self._mesh is None:
@@ -132,15 +150,25 @@ class PertInference:
         return shard_batch(self._mesh, batch), shard_params(self._mesh, params)
 
     def _pad(self, data: PertData) -> PertData:
+        from scdna_replication_tools_tpu.parallel.mesh import (
+            CELLS_AXIS,
+            LOCI_AXIS,
+        )
         mult = 1
+        loci_mult = 1
         if self._mesh is not None:
-            mult *= self._mesh.devices.size
+            mult *= self._mesh.shape[CELLS_AXIS]
+            loci_mult = self._mesh.shape.get(LOCI_AXIS, 1)
         if self.config.cell_chunk:
             assert self._mesh is None, (
                 "cell_chunk is a single-device memory knob; use sharding "
                 "for multi-device runs")
             mult *= self.config.cell_chunk
-        return pad_cells(data, mult) if mult > 1 else data
+        if mult > 1:
+            data = pad_cells(data, mult)
+        if loci_mult > 1:
+            data = pad_loci(data, loci_mult)
+        return data
 
     def g1_g2_doubled_batch(self) -> Tuple[PertBatch, PertData]:
         """Step-1 batch: every G1 cell appears as G1 (rep=0) and G2 (rep=1).
@@ -158,10 +186,11 @@ class PertInference:
         batch = PertBatch(
             reads=jnp.asarray(reads),
             libs=jnp.asarray(libs),
-            gamma_feats=self._gamma_feats(),
+            gamma_feats=self._gamma_feats(g1),
             mask=jnp.asarray(mask),
             cn_obs=jnp.asarray(states),
             rep_obs=jnp.asarray(rep),
+            loci_mask=_loci_mask_arr(g1),
         )
         return batch, g1
 
@@ -277,17 +306,23 @@ class PertInference:
             "beta_means": c1["beta_means"],   # pert_model.py:782-787
             "lamb": c1["lamb"],               # pert_model.py:801 (lamb=...)
         }
+        # initial S-phase times from the real (unpadded) cells/loci only
+        t_init_real, _, _ = guess_times(jnp.asarray(self.s.reads),
+                                        jnp.asarray(etas),
+                                        float(self.config.upsilon),
+                                        loci_mask=self.s.loci_mask)
         s = self._pad(self.s)
-        etas_padded = _pad_etas(etas, s.num_cells)
-        t_init, _, _ = guess_times(jnp.asarray(s.reads),
-                                   jnp.asarray(etas_padded),
-                                   float(self.config.upsilon))
+        etas_padded = _pad_etas(etas, s.num_cells, s.num_loci)
+        t_init = np.pad(np.asarray(t_init_real),
+                        (0, s.num_cells - self.s.num_cells),
+                        constant_values=0.4)
         batch = PertBatch(
             reads=jnp.asarray(s.reads),
             libs=jnp.asarray(s.libs),
-            gamma_feats=self._gamma_feats(),
+            gamma_feats=self._gamma_feats(s),
             mask=jnp.asarray(s.cell_mask.astype(np.float32)),
             etas=jnp.asarray(etas_padded),
+            loci_mask=_loci_mask_arr(s),
         )
         spec = PertModelSpec(
             P=self.config.P, K=self.config.K, L=self.L,
@@ -309,17 +344,23 @@ class PertInference:
             "rho": c2["rho"],                 # pert_model.py:844-851
             "a": c2["a"],
         }
+        etas2_real = self.build_etas_step3()
+        t_init2_real, _, _ = guess_times(jnp.asarray(self.g1.reads),
+                                         jnp.asarray(etas2_real),
+                                         float(self.config.upsilon),
+                                         loci_mask=self.g1.loci_mask)
         g1 = self._pad(self.g1)
-        etas2 = _pad_etas(self.build_etas_step3(), g1.num_cells)
-        t_init2, _, _ = guess_times(jnp.asarray(g1.reads),
-                                    jnp.asarray(etas2),
-                                    float(self.config.upsilon))
+        etas2 = _pad_etas(etas2_real, g1.num_cells, g1.num_loci)
+        t_init2 = np.pad(np.asarray(t_init2_real),
+                         (0, g1.num_cells - self.g1.num_cells),
+                         constant_values=0.4)
         batch = PertBatch(
             reads=jnp.asarray(g1.reads),
             libs=jnp.asarray(g1.libs),
-            gamma_feats=self._gamma_feats(),
+            gamma_feats=self._gamma_feats(g1),
             mask=jnp.asarray(g1.cell_mask.astype(np.float32)),
             etas=jnp.asarray(etas2),
+            loci_mask=_loci_mask_arr(g1),
         )
         spec = PertModelSpec(
             P=self.config.P, K=self.config.K, L=self.L,

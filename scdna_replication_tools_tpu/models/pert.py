@@ -111,6 +111,7 @@ class PertBatch:
       libs       (cells,) int32
       gamma_feats(loci, K+1) float32 — precomputed GC polynomial features
       mask       (cells,) float32 — 1 for real cells, 0 for padding
+      loci_mask  (loci,) float32 or None — 1 for real loci (None = all real)
       etas       (cells, loci, P) float32 or None — CN prior concentrations
       cn_obs     (cells, loci) float32 or None — step-1 conditioned CN
       rep_obs    (cells, loci) float32 or None — step-1 conditioned rep
@@ -118,7 +119,8 @@ class PertBatch:
     """
 
     def __init__(self, reads, libs, gamma_feats, mask, etas=None,
-                 cn_obs=None, rep_obs=None, t_alpha=None, t_beta=None):
+                 cn_obs=None, rep_obs=None, t_alpha=None, t_beta=None,
+                 loci_mask=None):
         self.reads = reads
         self.libs = libs
         self.gamma_feats = gamma_feats
@@ -128,12 +130,19 @@ class PertBatch:
         self.rep_obs = rep_obs
         self.t_alpha = t_alpha
         self.t_beta = t_beta
+        self.loci_mask = loci_mask
 
     def tree_flatten(self):
         children = (self.reads, self.libs, self.gamma_feats, self.mask,
                     self.etas, self.cn_obs, self.rep_obs, self.t_alpha,
-                    self.t_beta)
+                    self.t_beta, self.loci_mask)
         return children, None
+
+    def effective_loci_mask(self):
+        """(loci,) float mask; all-ones when loci_mask is None."""
+        if self.loci_mask is not None:
+            return self.loci_mask
+        return jnp.ones((self.reads.shape[1],), jnp.float32)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -189,7 +198,8 @@ def init_params(spec: PertModelSpec, batch: PertBatch, fixed: dict,
     # u init at the prior median u_guess evaluated at the initial tau
     tau0 = to_unit_interval(params["tau_raw"])
     ploidies0 = _cell_ploidies(spec, batch)
-    u_guess0 = jnp.mean(batch.reads, axis=1) / ((1.0 + tau0) * ploidies0)
+    u_guess0 = _loci_mean(batch.reads, batch.effective_loci_mask()) \
+        / ((1.0 + tau0) * ploidies0)
     params["u"] = u_guess0.astype(jnp.float32)
 
     beta_means0 = fixed["beta_means"] if spec.cond_beta_means else params["beta_means"]
@@ -204,13 +214,18 @@ def init_params(spec: PertModelSpec, batch: PertBatch, fixed: dict,
     return params
 
 
+def _loci_mean(x: jnp.ndarray, lmask: jnp.ndarray) -> jnp.ndarray:
+    """Mean over the loci axis restricted to real (unmasked) loci."""
+    return jnp.sum(x * lmask[None, :], axis=1) / jnp.sum(lmask)
+
+
 def _cell_ploidies(spec: PertModelSpec, batch: PertBatch) -> jnp.ndarray:
     """Per-cell ploidy guess feeding the u prior (reference:
     pert_model.py:589-600): argmax of etas when provided, else 2.0.
     (cn0 is only ever supplied by the simulator.)"""
     if batch.etas is not None and not spec.step1:
         cn_mode = jnp.argmax(batch.etas, axis=-1).astype(jnp.float32)
-        return jnp.mean(cn_mode, axis=1)
+        return _loci_mean(cn_mode, batch.effective_loci_mask())
     return jnp.full((batch.reads.shape[0],), 2.0, jnp.float32)
 
 
@@ -337,12 +352,13 @@ def _enum_bin_loglik(spec, reads, u, omega, log_pi, phi, lamb, log_lamb,
             return enum_loglik(reads, mu, log_pi, phi, lamb, interpret)
         from jax.sharding import PartitionSpec as PS
         cells = mesh.axis_names[0]
+        lx = mesh.axis_names[1] if len(mesh.axis_names) > 1 else None
         fn = jax.shard_map(
             functools.partial(enum_loglik, interpret=interpret),
             mesh=mesh,
-            in_specs=(PS(cells, None), PS(cells, None),
-                      PS(cells, None, None), PS(cells, None), PS()),
-            out_specs=PS(cells, None),
+            in_specs=(PS(cells, lx), PS(cells, lx),
+                      PS(cells, lx, None), PS(cells, lx), PS()),
+            out_specs=PS(cells, lx),
             # pallas_call's out_shape carries no varying-mesh-axes info;
             # skip the vma check (the op is pointwise over cells)
             check_vma=False,
@@ -377,8 +393,9 @@ def log_joint(spec: PertModelSpec, params: dict, fixed: dict,
     mask = batch.mask
 
     lp = _global_log_prior(spec, c)
+    lmask = batch.effective_loci_mask()
 
-    reads_mean = jnp.mean(batch.reads, axis=1)
+    reads_mean = _loci_mean(batch.reads, lmask)
     ploidies = _cell_ploidies(spec, batch)
     lp += jnp.sum(_per_cell_log_prior(spec, c, batch, reads_mean, ploidies) * mask)
 
@@ -393,7 +410,7 @@ def log_joint(spec: PertModelSpec, params: dict, fixed: dict,
         + gammaln(jnp.sum(etas, axis=-1))
         - jnp.sum(gammaln(etas), axis=-1)
     )
-    lp += jnp.sum(lp_pi * mask[:, None])
+    lp += jnp.sum(lp_pi * mask[:, None] * lmask[None, :])
 
     phi = _phi(c, num_loci)
     omega = gc_rate(c["betas"], batch.gamma_feats)               # :632-633
@@ -409,7 +426,7 @@ def log_joint(spec: PertModelSpec, params: dict, fixed: dict,
     if spec.cell_chunk is None:
         ll = bin_ll(batch.reads, c["u"], omega, log_pi, phi,
                     batch.cn_obs, batch.rep_obs)
-        lp += jnp.sum(ll * mask[:, None])
+        lp += jnp.sum(ll * mask[:, None] * lmask[None, :])
     else:
         # chunk the cells axis through lax.map so only a
         # (chunk, loci, P, 2) slab of the enumeration tensor is live at once
@@ -427,7 +444,7 @@ def log_joint(spec: PertModelSpec, params: dict, fixed: dict,
         def body(args):
             reads, u, omega_, log_pi_, phi_, cn_obs, rep_obs, m = args
             return jnp.sum(bin_ll(reads, u, omega_, log_pi_, phi_, cn_obs,
-                                  rep_obs) * m[:, None])
+                                  rep_obs) * m[:, None] * lmask[None, :])
 
         present = [x for x in chunks if x is not None]
         idxs = [i for i, x in enumerate(chunks) if x is not None]

@@ -227,7 +227,8 @@ def manhattan_binarize(
     return rt_state, frac_rt, best_t, (mu, var, w), all_dists.T
 
 
-def guess_times(reads: jnp.ndarray, etas: jnp.ndarray, upsilon: float = 6.0):
+def guess_times(reads: jnp.ndarray, etas: jnp.ndarray, upsilon: float = 6.0,
+                loci_mask=None):
     """Initial guess of each cell's time in S-phase.
 
     Vectorised ``guess_times`` (reference: pert_model.py:426-457): read
@@ -235,7 +236,16 @@ def guess_times(reads: jnp.ndarray, etas: jnp.ndarray, upsilon: float = 6.0):
     prior says homozygous deletion) and Manhattan-binarised; the
     replicated fraction seeds ``t_init`` and a Beta(alpha, upsilon-alpha)
     prior.
+
+    ``loci_mask`` (optional bool (loci,)) drops padded loci before the
+    binarisation statistics — called host-side, so the dynamic shape is
+    fine; the result is per-cell and unaffected by loci layout.
     """
+    if loci_mask is not None:
+        keep = np.asarray(loci_mask).astype(bool)
+        if not keep.all():
+            reads = jnp.asarray(reads)[:, keep]
+            etas = jnp.asarray(etas)[:, keep]
     cn_states = jnp.argmax(etas, axis=-1).astype(jnp.float32)
     denom = jnp.where(cn_states > 0.0, cn_states, 0.5)
     reads_norm = jnp.asarray(reads, jnp.float32) / denom

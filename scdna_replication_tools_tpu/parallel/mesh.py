@@ -2,24 +2,30 @@
 
 The reference is single-process with a lone ``cuda`` flag
 (reference: pert_model.py:70, 101, 649-651); the TPU-native scale-out
-story is data parallelism over the **cells** axis of a 1-D
-``jax.sharding.Mesh``:
+story is a 1-D or 2-D ``jax.sharding.Mesh``:
 
-* the model factorises across cells given the global latents (a, lambda,
-  beta_means, rho), so per-cell data *and* per-cell parameters (tau, u,
-  betas, and the big (cells, loci, P) pi tensor) shard cleanly along
-  'cells' — parameter sharding here is FSDP-like: each device owns its
-  cells' parameter slices outright, no gathering needed;
-* global parameters are replicated; their gradients are an all-reduce
-  (psum) that XLA inserts automatically from the sharding annotations —
-  the collectives ride ICI within a slice / DCN across slices;
-* the per-locus ``rho`` is replicated by default (loci counts are ~5.4k at
-  500kb; replication is cheap and keeps the phi outer-product local).
+* **cells** is the primary data-parallel axis: the model factorises
+  across cells given the global latents (a, lambda, beta_means, rho), so
+  per-cell data *and* per-cell parameters (tau, u, betas, and the big
+  (cells, loci, P) pi tensor) shard cleanly along 'cells' — FSDP-like:
+  each device owns its cells' parameter slices outright, no gathering;
+* **loci** is the optional second axis for the long-genome regime (20kb
+  bins: ~136k loci — the reference README warns this is runtime/NaN
+  territory, README.md:55-57).  The likelihood has no cross-locus
+  coupling, so reads/etas/pi shard over ('cells', 'loci') tiles and the
+  per-locus rho shards over 'loci'.  Only the per-cell reductions (u
+  prior's masked read-mean, the final loss sum) cross loci — XLA turns
+  those into psums over the loci axis;
+* global parameters are replicated; their gradients become all-reduces
+  that XLA inserts from the sharding annotations — the collectives ride
+  ICI within a slice / DCN across slices.
 
 Everything is expressed through placement (``jax.device_put`` with
 ``NamedSharding``) + sharding propagation under ``jax.jit`` — no explicit
 collectives in user code, per the scaling-book recipe: pick a mesh,
-annotate shardings, let XLA insert the collectives.
+annotate shardings, let XLA insert the collectives.  The one exception is
+the fused Pallas kernel, which runs per-device under ``shard_map``
+(models/pert._enum_bin_loglik) with specs built from the same axis names.
 """
 
 from __future__ import annotations
@@ -33,16 +39,30 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from scdna_replication_tools_tpu.models.pert import PertBatch
 
 CELLS_AXIS = "cells"
+LOCI_AXIS = "loci"
 
 
-def make_mesh(num_devices: Optional[int] = None,
-              devices=None) -> Mesh:
-    """1-D mesh over the cells axis."""
+def make_mesh(num_devices: Optional[int] = None, devices=None,
+              loci_shards: int = 1) -> Mesh:
+    """Mesh over the cells axis, optionally 2-D (cells x loci).
+
+    ``num_devices`` counts *cell* shards; total devices used is
+    ``num_devices * loci_shards``.
+    """
     if devices is None:
         devices = jax.devices()
-    if num_devices is not None:
-        devices = devices[:num_devices]
-    return Mesh(np.array(devices), (CELLS_AXIS,))
+    if num_devices is None:
+        num_devices = len(devices) // loci_shards
+    devices = devices[:num_devices * loci_shards]
+    if loci_shards == 1:
+        return Mesh(np.array(devices), (CELLS_AXIS,))
+    grid = np.array(devices).reshape(num_devices, loci_shards)
+    return Mesh(grid, (CELLS_AXIS, LOCI_AXIS))
+
+
+def loci_axis(mesh: Mesh) -> Optional[str]:
+    """'loci' when the mesh shards the loci axis, else None."""
+    return LOCI_AXIS if LOCI_AXIS in mesh.axis_names else None
 
 
 def _put(mesh: Mesh, x, spec: P):
@@ -52,36 +72,42 @@ def _put(mesh: Mesh, x, spec: P):
 
 
 def shard_batch(mesh: Mesh, batch: PertBatch) -> PertBatch:
-    """Place a PertBatch on the mesh: cells axis sharded, loci replicated."""
+    """Place a PertBatch on the mesh: cells (and optionally loci) sharded."""
+    lx = loci_axis(mesh)
     cells = P(CELLS_AXIS)
-    cells_loci = P(CELLS_AXIS, None)
+    cells_loci = P(CELLS_AXIS, lx)
     return PertBatch(
         reads=_put(mesh, batch.reads, cells_loci),
         libs=_put(mesh, batch.libs, cells),
-        gamma_feats=_put(mesh, batch.gamma_feats, P()),
+        gamma_feats=_put(mesh, batch.gamma_feats, P(lx, None)),
         mask=_put(mesh, batch.mask, cells),
-        etas=_put(mesh, batch.etas, P(CELLS_AXIS, None, None)),
+        etas=_put(mesh, batch.etas, P(CELLS_AXIS, lx, None)),
         cn_obs=_put(mesh, batch.cn_obs, cells_loci),
         rep_obs=_put(mesh, batch.rep_obs, cells_loci),
         t_alpha=_put(mesh, batch.t_alpha, cells),
         t_beta=_put(mesh, batch.t_beta, cells),
+        loci_mask=_put(mesh, batch.loci_mask, P(lx)),
     )
 
 
-# parameter name -> PartitionSpec over the cells mesh
-_PARAM_SPECS = {
-    "a_raw": P(),
-    "lamb_raw": P(),
-    "beta_means": P(),
-    "beta_stds_raw": P(),
-    "rho_raw": P(),
-    "tau_raw": P(CELLS_AXIS),
-    "u": P(CELLS_AXIS),
-    "betas": P(CELLS_AXIS, None),
-    "pi_logits": P(CELLS_AXIS, None, None),
-}
+def _param_specs(mesh: Mesh) -> dict:
+    """Parameter name -> PartitionSpec for this mesh."""
+    lx = loci_axis(mesh)
+    return {
+        "a_raw": P(),
+        "lamb_raw": P(),
+        "beta_means": P(),
+        "beta_stds_raw": P(),
+        "rho_raw": P(lx),
+        "tau_raw": P(CELLS_AXIS),
+        "u": P(CELLS_AXIS),
+        "betas": P(CELLS_AXIS, None),
+        "pi_logits": P(CELLS_AXIS, lx, None),
+    }
 
 
 def shard_params(mesh: Mesh, params: dict) -> dict:
-    """Place the parameter pytree: per-cell params sharded, globals replicated."""
-    return {k: _put(mesh, v, _PARAM_SPECS[k]) for k, v in params.items()}
+    """Place the parameter pytree: per-cell/per-locus params sharded,
+    globals replicated."""
+    specs = _param_specs(mesh)
+    return {k: _put(mesh, v, specs[k]) for k, v in params.items()}

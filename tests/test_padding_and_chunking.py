@@ -10,17 +10,7 @@ from scdna_replication_tools_tpu.data.loader import build_pert_inputs
 from scdna_replication_tools_tpu.infer.runner import PertInference, _pad_etas
 
 
-def _dense_inputs(synthetic_frames):
-    df_s, df_g = synthetic_frames
-    rng = np.random.default_rng(0)
-    for df in (df_s, df_g):
-        df["reads"] = rng.poisson(
-            40 * df["true_somatic_cn"].to_numpy()).astype(float)
-        df["state"] = df["true_somatic_cn"].astype(int)
-    cols = ColumnConfig(rt_prior_col=None)
-    s, g1 = build_pert_inputs(df_s, df_g, cols)
-    clone_idx = np.array([0] * 12 + [1] * 12, np.int32)
-    return s, g1, clone_idx
+from conftest import dense_inputs_from_frames as _dense_inputs  # noqa: E402
 
 
 def test_pad_etas_keeps_ploidy_positive():
@@ -77,3 +67,83 @@ def test_sharded_pallas_matches_single_device_xla(synthetic_frames):
     sharded = run(num_shards=8, enum_impl="pallas_interpret")
     assert sharded.shape == ref.shape
     np.testing.assert_allclose(sharded, ref, rtol=2e-4)
+
+
+def test_loci_padding_does_not_change_losses(synthetic_frames):
+    """Masked loci padding must be loss-invariant: a fit on 120 loci and a
+    fit on the same data padded to 128 masked loci give identical loss
+    trajectories (pins the masked reductions in the model)."""
+    from scdna_replication_tools_tpu.data.loader import pad_loci
+
+    s, g1, clone_idx = _dense_inputs(synthetic_frames)
+
+    def run(s_in, g1_in):
+        config = PertConfig(cn_prior_method="g1_clones", max_iter=25,
+                            min_iter=12, run_step3=False)
+        inf = PertInference(s_in, g1_in, config, clone_idx_s=clone_idx,
+                            clone_idx_g1=clone_idx, num_clones=2)
+        step1, step2, _ = inf.run()
+        return step1.fit.losses, step2.fit.losses
+
+    l1_ref, l2_ref = run(s, g1)
+    l1_pad, l2_pad = run(pad_loci(s, 128), pad_loci(g1, 128))
+    np.testing.assert_allclose(l1_pad, l1_ref, rtol=1e-5)
+    np.testing.assert_allclose(l2_pad, l2_ref, rtol=1e-5)
+
+
+def test_2d_mesh_cells_x_loci(synthetic_frames):
+    """2x4 (cells x loci) mesh over 8 virtual devices.
+
+    Sharding the loci axis reassociates the loci reductions (psum), so
+    gradients differ at float32 epsilon and Adam chaotically amplifies
+    that over iterations: iteration 0 must agree tightly (same math),
+    the trajectory only loosely."""
+    s, g1, clone_idx = _dense_inputs(synthetic_frames)
+
+    def run(**kw):
+        config = PertConfig(cn_prior_method="g1_clones", max_iter=25,
+                            min_iter=12, run_step3=False, **kw)
+        inf = PertInference(s, g1, config, clone_idx_s=clone_idx,
+                            clone_idx_g1=clone_idx, num_clones=2)
+        step1, step2, _ = inf.run()
+        return step1.fit.losses, step2.fit.losses
+
+    l1_ref, l2_ref = run(num_shards=1)
+    l1_sh, l2_sh = run(num_shards=2, loci_shards=4)
+    np.testing.assert_allclose(l1_sh[0], l1_ref[0], rtol=1e-5)
+    np.testing.assert_allclose(l1_sh, l1_ref, rtol=2e-2)
+    np.testing.assert_allclose(l2_sh, l2_ref, rtol=2e-2)
+
+
+def test_2d_mesh_with_loci_padding_and_pallas(synthetic_frames):
+    """2x4 mesh where 120 loci pad to a multiple of 4 plus the interpreted
+    Pallas kernel under shard_map — the full long-genome configuration."""
+    from scdna_replication_tools_tpu.data.loader import pad_loci
+
+    s, g1, clone_idx = _dense_inputs(synthetic_frames)
+    # make loci count awkward: drop 3 loci so 117 must pad to 120
+    import dataclasses as dc
+    import pandas as pd
+
+    def trim(d):
+        return dc.replace(
+            d, reads=d.reads[:, :117],
+            states=None if d.states is None else d.states[:, :117],
+            gammas=d.gammas[:117],
+            rt_prior=None if d.rt_prior is None else d.rt_prior[:117],
+            loci=d.loci[:117], loci_mask=d.loci_mask[:117])
+
+    s, g1 = trim(s), trim(g1)
+
+    def run(**kw):
+        config = PertConfig(cn_prior_method="g1_clones", max_iter=25,
+                            min_iter=12, run_step3=False, **kw)
+        inf = PertInference(s, g1, config, clone_idx_s=clone_idx,
+                            clone_idx_g1=clone_idx, num_clones=2)
+        _, step2, _ = inf.run()
+        return step2.fit.losses
+
+    ref = run(num_shards=1, enum_impl="xla")
+    sharded = run(num_shards=2, loci_shards=4, enum_impl="pallas_interpret")
+    # same chaotic-amplification caveat as test_2d_mesh_cells_x_loci
+    np.testing.assert_allclose(sharded, ref, rtol=2e-2)
