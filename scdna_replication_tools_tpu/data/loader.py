@@ -72,18 +72,65 @@ def pivot_matrix(
     Equivalent to the reference's ``pivot_table(index=cell, columns=[chr,
     start])`` calls (reference: pert_model.py:143-146) but keeps cells as
     rows (our batch axis).
+
+    Fast path: keys are factorised once and the values scattered directly
+    into the dense matrix (multithreaded C++ when built — see
+    ``native/pivot.cpp`` — NumPy otherwise) instead of pandas groupby
+    machinery.  Duplicate (cell, locus) keys fall back to pivot_table,
+    whose mean-aggregation the scatter cannot reproduce.
     """
-    cn = cn[cn[value_col].notna()].copy()
-    cn[cols.chr_col] = as_chr_categorical(cn[cols.chr_col])
-    mat = cn.pivot_table(
-        index=cols.cell_col,
-        columns=[cols.chr_col, cols.start_col],
-        values=value_col,
-        observed=True,
-    )
-    # pivot_table sorts the categorical chr level; enforce genomic order
-    mat = mat.sort_index(axis=1)
-    return mat
+    from scdna_replication_tools_tpu.native.pivot import scatter_pivot
+
+    # pivot_table drops any row whose group key is NaN (cell id or start)
+    # or whose chromosome is outside the canonical categories (code -1,
+    # observed=True); match all three here
+    cn = cn[cn[value_col].notna()
+            & cn[cols.cell_col].notna()
+            & cn[cols.start_col].notna()]
+    chr_cat = as_chr_categorical(cn[cols.chr_col])
+    known = chr_cat.cat.codes.to_numpy() >= 0
+    if not known.all():
+        cn = cn[known]
+        chr_cat = chr_cat[known]
+
+    def _sorted_factorize(values):
+        # hash-based factorize (O(n), no 10M-row sort), then rank-remap the
+        # small uniques array so codes follow sorted order
+        codes, uniques = pd.factorize(values)
+        uniques = np.asarray(uniques)
+        order = np.argsort(uniques, kind="stable")
+        rank = np.empty(len(uniques), np.int64)
+        rank[order] = np.arange(len(uniques))
+        return uniques[order], rank[codes]
+
+    cell_ids, cell_codes = _sorted_factorize(cn[cols.cell_col].to_numpy())
+    starts = cn[cols.start_col].to_numpy(np.int64)
+    # genome-ordered locus key: chr categorical code in the high bits
+    locus_key = chr_cat.cat.codes.to_numpy(np.int64) << 42 | starts
+    key_vals, locus_codes = _sorted_factorize(locus_key)
+
+    pair_key = cell_codes * len(key_vals) + locus_codes
+    if len(pd.unique(pair_key)) != len(pair_key):
+        mat = cn.assign(**{cols.chr_col: chr_cat}).pivot_table(
+            index=cols.cell_col,
+            columns=[cols.chr_col, cols.start_col],
+            values=value_col,
+            observed=True,
+        )
+        return mat.sort_index(axis=1)
+
+    dense = scatter_pivot(cell_codes, locus_codes,
+                          cn[value_col].to_numpy(np.float64),
+                          len(cell_ids), len(key_vals))
+
+    chr_categories = chr_cat.cat.categories
+    loci = pd.MultiIndex.from_arrays(
+        [pd.Categorical.from_codes((key_vals >> 42).astype(np.int32),
+                                   categories=chr_categories),
+         key_vals & ((1 << 42) - 1)],
+        names=[cols.chr_col, cols.start_col])
+    return pd.DataFrame(dense, index=pd.Index(cell_ids, name=cols.cell_col),
+                        columns=loci)
 
 
 def _library_index(

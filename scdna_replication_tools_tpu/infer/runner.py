@@ -51,6 +51,8 @@ from scdna_replication_tools_tpu.ops.gc import gc_features
 from scdna_replication_tools_tpu.ops.stats import guess_times, pearson_matrix
 from scdna_replication_tools_tpu.utils import profiling
 from scdna_replication_tools_tpu.parallel.mesh import (
+    CELLS_AXIS,
+    LOCI_AXIS,
     make_mesh,
     shard_batch,
     shard_params,
@@ -82,8 +84,11 @@ def _pad_etas(etas: np.ndarray, target_cells: int,
 
 
 def _loci_mask_arr(data: PertData):
-    """(loci,) float mask for PertBatch, or None when all loci are real."""
-    if data.loci_mask is None:
+    """(loci,) float mask for PertBatch, or None when all loci are real.
+
+    Returning None for an all-true mask keeps the compiled loss free of
+    dead all-ones multiplies in the common unpadded case."""
+    if data.loci_mask is None or data.loci_mask.all():
         return None
     return jnp.asarray(data.loci_mask.astype(np.float32))
 
@@ -150,10 +155,6 @@ class PertInference:
         return shard_batch(self._mesh, batch), shard_params(self._mesh, params)
 
     def _pad(self, data: PertData) -> PertData:
-        from scdna_replication_tools_tpu.parallel.mesh import (
-            CELLS_AXIS,
-            LOCI_AXIS,
-        )
         mult = 1
         loci_mult = 1
         if self._mesh is not None:

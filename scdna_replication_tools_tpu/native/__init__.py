@@ -1,0 +1,17 @@
+"""Native host-runtime components (C++ via ctypes).
+
+The TPU compute path is JAX/XLA/Pallas; the host runtime around it —
+here, the long-form ⇄ dense data-marshalling that feeds every fit — has a
+native implementation compiled on first use (see ``build.py``).  All
+entry points degrade gracefully to NumPy when no C++ toolchain is
+available, so the package has no hard native dependency.
+"""
+
+from scdna_replication_tools_tpu.native.build import (  # noqa: F401
+    get_native_lib,
+    native_available,
+)
+from scdna_replication_tools_tpu.native.pivot import (  # noqa: F401
+    gather_melt,
+    scatter_pivot,
+)

@@ -1,0 +1,80 @@
+"""Lazy on-first-use build of the native host library.
+
+pybind11 is not available in this environment, so the binding layer is
+ctypes over a plain ``extern "C"`` shared object.  The .so is compiled
+once per interpreter ABI into ``_build/`` next to the sources and reused
+across processes; failures (no g++, sandboxed filesystem, ...) are
+cached as "unavailable" and callers fall back to NumPy.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import sysconfig
+import threading
+from typing import Optional
+
+logger = logging.getLogger("scdna_replication_tools_tpu")
+
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _build_dir() -> str:
+    return os.path.join(os.path.dirname(__file__), "_build")
+
+
+def _so_path() -> str:
+    tag = sysconfig.get_config_var("SOABI") or "generic"
+    return os.path.join(_build_dir(), f"pivot.{tag}.so")
+
+
+def _compile() -> Optional[str]:
+    src = os.path.join(os.path.dirname(__file__), "pivot.cpp")
+    out = _so_path()
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    os.makedirs(_build_dir(), exist_ok=True)
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           src, "-o", out]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError) as exc:
+        logger.info("native pivot build unavailable (%s); using NumPy "
+                    "fallback", exc)
+        return None
+    return out
+
+
+def get_native_lib() -> Optional[ctypes.CDLL]:
+    """The compiled library, building it on first call; None if unbuildable."""
+    global _LIB, _TRIED
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        path = _compile()
+        if path is None:
+            return None
+        lib = ctypes.CDLL(path)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        f64p = ctypes.POINTER(ctypes.c_double)
+        lib.scatter_pivot_f32.argtypes = [
+            i32p, i32p, f64p, ctypes.c_int64, f32p, ctypes.c_int64,
+            ctypes.c_int32]
+        lib.scatter_pivot_f32.restype = None
+        lib.gather_melt_f32.argtypes = [
+            f32p, i32p, i32p, ctypes.c_int64, ctypes.c_int64, f32p,
+            ctypes.c_int32]
+        lib.gather_melt_f32.restype = None
+        _LIB = lib
+        return _LIB
+
+
+def native_available() -> bool:
+    return get_native_lib() is not None

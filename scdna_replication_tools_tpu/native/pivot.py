@@ -1,0 +1,82 @@
+"""Long-form ⇄ dense marshalling on the native library (NumPy fallback).
+
+``scatter_pivot`` replaces the pandas ``pivot_table`` walk of the
+reference's ``process_input_data`` (reference: pert_model.py:143-146):
+keys are factorised once and values scattered straight into the dense
+(cells x loci) matrix — the multithreaded C++ kernel when available, a
+single NumPy fancy-assignment otherwise.  Semantics: one row per
+(cell, locus) key; with duplicate keys the last row wins (the loader
+checks the contract upstream).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from scdna_replication_tools_tpu.native.build import get_native_lib
+
+
+def _threads() -> int:
+    return max(1, min(16, os.cpu_count() or 1))
+
+
+def scatter_pivot(cell_codes: np.ndarray, locus_codes: np.ndarray,
+                  values: np.ndarray, n_cells: int, n_loci: int,
+                  use_native: Optional[bool] = None) -> np.ndarray:
+    """Dense (n_cells, n_loci) float32 matrix, NaN where no key appeared."""
+    out = np.full((n_cells, n_loci), np.nan, np.float32)
+    cell_codes = np.ascontiguousarray(cell_codes, np.int32)
+    locus_codes = np.ascontiguousarray(locus_codes, np.int32)
+    values = np.ascontiguousarray(values, np.float64)
+
+    lib = get_native_lib() if use_native in (None, True) else None
+    if lib is None:
+        if use_native is True:
+            raise RuntimeError("native pivot requested but unavailable")
+        out[cell_codes, locus_codes] = values
+        return out
+
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.scatter_pivot_f32(
+        cell_codes.ctypes.data_as(i32p),
+        locus_codes.ctypes.data_as(i32p),
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int64(len(values)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_int64(n_loci),
+        ctypes.c_int32(_threads()),
+    )
+    return out
+
+
+def gather_melt(mat: np.ndarray, cell_codes: np.ndarray,
+                locus_codes: np.ndarray,
+                use_native: Optional[bool] = None) -> np.ndarray:
+    """Values of ``mat`` at each (cell, locus) key — dense back to long."""
+    mat = np.ascontiguousarray(mat, np.float32)
+    cell_codes = np.ascontiguousarray(cell_codes, np.int32)
+    locus_codes = np.ascontiguousarray(locus_codes, np.int32)
+
+    lib = get_native_lib() if use_native in (None, True) else None
+    if lib is None:
+        if use_native is True:
+            raise RuntimeError("native gather requested but unavailable")
+        return mat[cell_codes, locus_codes]
+
+    out = np.empty(len(cell_codes), np.float32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    lib.gather_melt_f32(
+        mat.ctypes.data_as(f32p),
+        cell_codes.ctypes.data_as(i32p),
+        locus_codes.ctypes.data_as(i32p),
+        ctypes.c_int64(len(cell_codes)),
+        ctypes.c_int64(mat.shape[1]),
+        out.ctypes.data_as(f32p),
+        ctypes.c_int32(_threads()),
+    )
+    return out

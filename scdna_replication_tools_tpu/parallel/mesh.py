@@ -52,8 +52,17 @@ def make_mesh(num_devices: Optional[int] = None, devices=None,
     if devices is None:
         devices = jax.devices()
     if num_devices is None:
+        if len(devices) % loci_shards != 0:
+            raise ValueError(
+                f"loci_shards={loci_shards} does not divide the "
+                f"{len(devices)} available devices")
         num_devices = len(devices) // loci_shards
-    devices = devices[:num_devices * loci_shards]
+    needed = num_devices * loci_shards
+    if needed > len(devices) or needed == 0:
+        raise ValueError(
+            f"mesh needs {num_devices} x {loci_shards} = {needed} devices; "
+            f"{len(devices)} available")
+    devices = devices[:needed]
     if loci_shards == 1:
         return Mesh(np.array(devices), (CELLS_AXIS,))
     grid = np.array(devices).reshape(num_devices, loci_shards)

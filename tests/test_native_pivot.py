@@ -1,0 +1,85 @@
+"""Tests for the native scatter-pivot and its loader integration."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from scdna_replication_tools_tpu.config import ColumnConfig
+from scdna_replication_tools_tpu.data.loader import pivot_matrix
+from scdna_replication_tools_tpu.native import native_available
+from scdna_replication_tools_tpu.native.pivot import gather_melt, scatter_pivot
+
+
+def _long_frame(num_cells=7, num_loci=50, seed=0, shuffle=True):
+    rng = np.random.default_rng(seed)
+    cells = [f"c{i:03d}" for i in range(num_cells)]
+    rows = []
+    for c in cells:
+        rows.append(pd.DataFrame({
+            "cell_id": c,
+            "chr": ["1"] * (num_loci // 2) + ["X"] * (num_loci - num_loci // 2),
+            "start": np.r_[np.arange(num_loci // 2),
+                           np.arange(num_loci - num_loci // 2)] * 500_000,
+            "reads": rng.poisson(40, num_loci).astype(float),
+        }))
+    df = pd.concat(rows, ignore_index=True)
+    if shuffle:
+        df = df.sample(frac=1.0, random_state=1).reset_index(drop=True)
+    return df
+
+
+def test_scatter_pivot_matches_numpy_fallback():
+    rng = np.random.default_rng(2)
+    n_cells, n_loci, n = 11, 37, 300
+    cc = rng.integers(0, n_cells, n).astype(np.int32)
+    lc = rng.integers(0, n_loci, n).astype(np.int32)
+    # dedupe keys (contract: one row per key)
+    _, keep = np.unique(cc.astype(np.int64) * n_loci + lc, return_index=True)
+    cc, lc = cc[keep], lc[keep]
+    vals = rng.normal(0, 10, len(cc))
+
+    a = scatter_pivot(cc, lc, vals, n_cells, n_loci, use_native=False)
+    b = scatter_pivot(cc, lc, vals, n_cells, n_loci)
+    np.testing.assert_array_equal(np.isnan(a), np.isnan(b))
+    np.testing.assert_allclose(np.nan_to_num(a), np.nan_to_num(b))
+
+    got = gather_melt(np.nan_to_num(a), cc, lc)
+    np.testing.assert_allclose(got, vals.astype(np.float32))
+
+
+def test_native_library_builds_here():
+    """The image ships g++, so the native path must actually build."""
+    assert native_available()
+
+
+def test_pivot_matrix_matches_pandas_pivot_table():
+    df = _long_frame()
+    cols = ColumnConfig()
+    got = pivot_matrix(df, "reads", cols)
+
+    from scdna_replication_tools_tpu.utils.chrom import as_chr_categorical
+    ref_df = df.copy()
+    ref_df["chr"] = as_chr_categorical(ref_df["chr"])
+    want = ref_df.pivot_table(index="cell_id", columns=["chr", "start"],
+                              values="reads", observed=True).sort_index(axis=1)
+    np.testing.assert_allclose(got.to_numpy(), want.to_numpy())
+    assert list(got.index) == list(want.index)
+    assert [tuple(map(str, t)) for t in got.columns] == \
+        [tuple(map(str, t)) for t in want.columns]
+
+
+def test_pivot_matrix_drops_unknown_chromosomes():
+    df = _long_frame(num_cells=3, num_loci=10)
+    weird = df.iloc[:5].copy()
+    weird["chr"] = "chrUn_gl000220"
+    got = pivot_matrix(pd.concat([df, weird], ignore_index=True), "reads")
+    want = pivot_matrix(df, "reads")
+    np.testing.assert_allclose(got.to_numpy(), want.to_numpy())
+
+
+def test_pivot_matrix_duplicate_keys_fall_back_to_mean():
+    df = _long_frame(num_cells=2, num_loci=6, shuffle=False)
+    dup = df.iloc[[0]].copy()
+    dup["reads"] = df.iloc[0]["reads"] + 10.0
+    got = pivot_matrix(pd.concat([df, dup], ignore_index=True), "reads")
+    assert got.iloc[0, 0] == df.iloc[0]["reads"] + 5.0  # pivot_table mean
