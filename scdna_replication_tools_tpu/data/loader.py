@@ -117,7 +117,8 @@ def pivot_matrix(
             values=value_col,
             observed=True,
         )
-        return mat.sort_index(axis=1)
+        # same float32 dtype as the scatter fast path
+        return mat.sort_index(axis=1).astype(np.float32)
 
     dense = scatter_pivot(cell_codes, locus_codes,
                           cn[value_col].to_numpy(np.float64),

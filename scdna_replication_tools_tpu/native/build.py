@@ -39,13 +39,21 @@ def _compile() -> Optional[str]:
     if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
         return out
     os.makedirs(_build_dir(), exist_ok=True)
+    # compile to a temp path + atomic rename so a concurrent process can
+    # never dlopen a half-written library
+    tmp = f"{out}.tmp.{os.getpid()}"
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           src, "-o", out]
+           src, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out)
     except (OSError, subprocess.SubprocessError) as exc:
         logger.info("native pivot build unavailable (%s); using NumPy "
                     "fallback", exc)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return None
     return out
 
@@ -60,7 +68,29 @@ def get_native_lib() -> Optional[ctypes.CDLL]:
         path = _compile()
         if path is None:
             return None
-        lib = ctypes.CDLL(path)
+        try:
+            lib = ctypes.CDLL(path)
+            lib.scatter_pivot_f32
+            lib.gather_melt_f32
+        except (OSError, AttributeError) as exc:
+            # stale/foreign binary (e.g. built on another ABI): rebuild
+            # once from source, else degrade to the NumPy fallback
+            logger.info("native pivot load failed (%s); rebuilding", exc)
+            try:
+                os.unlink(path)
+            except OSError:
+                return None
+            path = _compile()
+            if path is None:
+                return None
+            try:
+                lib = ctypes.CDLL(path)
+                lib.scatter_pivot_f32
+                lib.gather_melt_f32
+            except (OSError, AttributeError) as exc2:
+                logger.info("native pivot unavailable (%s); using NumPy "
+                            "fallback", exc2)
+                return None
         i32p = ctypes.POINTER(ctypes.c_int32)
         f32p = ctypes.POINTER(ctypes.c_float)
         f64p = ctypes.POINTER(ctypes.c_double)

@@ -304,3 +304,134 @@ def get_cluster_colors(cluster_ids, color_map=None):
         c = color_map.get(cid, _CLONE_COLOR_CYCLE[i % len(_CLONE_COLOR_CYCLE)])
         resolved[cid] = matplotlib.colors.to_rgba(c)
     return [resolved[c] for c in cluster_ids], resolved
+
+
+# ---------------------------------------------------------------------------
+# cohort / experiment label registries
+# (reference: plot_utils.py:324-561 — study-specific color registries the
+# downstream analysis notebooks key on; regenerated here with equivalent
+# label coverage)
+# ---------------------------------------------------------------------------
+
+def _dual_keyed(pairs):
+    """Registry mapping both string labels and their integer aliases."""
+    cmap = {}
+    for i, (label, color) in enumerate(pairs):
+        cmap[label] = color
+        cmap[i if not isinstance(label, int) else label] = color
+    return cmap
+
+
+def get_signals_cmap(return_colors=False):
+    """Allele-specific CN states (A-Hom ... B-Hom), also keyed -2..2
+    (reference: plot_utils.py:324-338)."""
+    colors = {
+        "A-Hom": "#56941E", -2: "#56941E",
+        "A-Gained": "#94C773", -1: "#94C773",
+        "Balanced": "#d5d5d4", 0: "#d5d5d4",
+        "B-Gained": "#7B52AE", 1: "#7B52AE",
+        "B-Hom": "#471871", 2: "#471871",
+    }
+    cmap = ListedColormap([colors[k] for k in ("A-Hom", "A-Gained",
+                                               "Balanced", "B-Gained",
+                                               "B-Hom")])
+    return (cmap, colors) if return_colors else cmap
+
+
+def get_methods_cmap() -> dict:
+    """Colors for method-comparison figures
+    (reference: plot_utils.py:361-371)."""
+    return {
+        "PERT": "yellowgreen", "PERT comp.": "yellowgreen",
+        "PERT clone": "olive", "Kronos": "lightcoral",
+        "laks": "darksalmon", "Laks": "darksalmon", "true": "steelblue",
+    }
+
+
+def get_htert_cmap() -> dict:
+    """hTERT cell-line genotypes / sample ids
+    (reference: plot_utils.py:433-452)."""
+    pairs = [
+        ("WT", "C0"), ("SA039", "C0"),
+        ("TP53-/-", "C1"), ("SA906a", "C1"), ("SA906b", "orange"),
+        ("TP53-/-,BRCA1+/-", "C2"), ("SA1292", "C2"),
+        ("TP53-/-,BRCA1-/-", "C3"), ("SA1056", "C3"),
+        ("TP53-/-,BRCA2+/-", "C4"), ("SA1188", "C4"),
+        ("TP53-/-,BRCA2-/-", "C5"), ("SA1054", "C5"),
+        ("SA1055", "chocolate"), ("OV2295", "lightgreen"),
+    ]
+    return dict(pairs)
+
+
+def get_facs_cmap() -> dict:
+    """FACS-isolated cell lines (reference: plot_utils.py:454-460)."""
+    return {
+        "GM18507": "mediumpurple", "SA928": "mediumpurple",
+        1: "mediumpurple",
+        "T47D": "khaki", "SA1044": "khaki", 2: "khaki",
+    }
+
+
+def get_metacohort_feature_cmap() -> dict:
+    """RT-predictor feature colors (reference: plot_utils.py:463-467)."""
+    import seaborn as sns
+
+    pal = sns.color_palette("cubehelix", 4)
+    return {"global": pal[0], "ploidy": pal[1], "type": pal[2],
+            "signature": pal[3]}
+
+
+def get_metacohort_cmaps(return_cdicts=False):
+    """(cell_type, signature, condition, ploidy) cmaps for metacohort
+    heatmap annotation tracks (reference: plot_utils.py:470-529)."""
+    from matplotlib.colors import LinearSegmentedColormap
+
+    cell_type = _dual_keyed([
+        ("hTERT", "lightsteelblue"), ("HGSOC", "teal"), ("TNBC", "salmon"),
+        ("OV2295", "lightgreen"), ("T47D", "khaki"),
+        ("GM18507", "mediumpurple"),
+    ])
+    signature = _dual_keyed([
+        ("FBI", "plum"), ("HRD", "cyan"), ("TD", "coral"), ("NA", "white"),
+    ])
+    # NaN cannot be a reliable dict key (id-based hash); callers should
+    # pd.isna() missing labels to "NA"/None before lookup
+    signature[None] = "white"
+    signature["N/A"] = "white"
+    condition = _dual_keyed([("Line", "tan"), ("PDX", "lightskyblue")])
+    ploidy = {2: "#CCCCCC", 3: "#FDCC8A", 4: "#FC8D59", 5: "#E34A33"}
+
+    def _cmap(name, cdict):
+        # one entry per category: string labels only (the integer aliases
+        # duplicate the same colors), first-seen order preserved
+        vals = list(dict.fromkeys(
+            v for k, v in cdict.items() if isinstance(k, str)))
+        if not vals:
+            vals = list(dict.fromkeys(cdict.values()))
+        return LinearSegmentedColormap.from_list(name, vals, N=len(vals))
+
+    cmaps = (_cmap("cell_type", cell_type), _cmap("signature", signature),
+             _cmap("condition", condition), _cmap("ploidy", ploidy))
+    if return_cdicts:
+        return cmaps, (cell_type, signature, condition, ploidy)
+    return cmaps
+
+
+def format_embedding_frame(ax, xlabel="PC1", ylabel="PC2"):
+    """Minimal-axes styling for PCA/UMAP embeddings: no ticks, short
+    bottom-left spines with axis labels (reference: plot_utils.py:274-292)."""
+    ax.set_xticks([])
+    ax.set_yticks([])
+    for side in ("top", "right"):
+        ax.spines[side].set_visible(False)
+    xlim, ylim = ax.get_xlim(), ax.get_ylim()
+    ax.spines["bottom"].set_bounds(xlim[0], xlim[0] + 0.25 * (xlim[1] - xlim[0]))
+    ax.spines["left"].set_bounds(ylim[0], ylim[0] + 0.25 * (ylim[1] - ylim[0]))
+    ax.set_xlabel(xlabel, loc="left")
+    ax.set_ylabel(ylabel, loc="bottom")
+    return ax
+
+
+# API-parity alias: the reference names its genome-axis scatter
+# ``plot_cell_cn_profile2`` (reference: plot_utils.py:15-163)
+plot_cell_cn_profile2 = plot_cell_cn_profile

@@ -55,3 +55,21 @@ def test_pad_cells_mask(synthetic_frames):
     assert not padded.cell_mask[-1]
     # original content preserved
     np.testing.assert_array_equal(padded.reads[:24], s.reads)
+
+
+def test_example_bins_schema():
+    from scdna_replication_tools_tpu.data.example_bins import make_example_bins
+
+    bins = make_example_bins(chroms=["1", "2", "X"])
+    assert list(bins.columns) == ["chr", "start", "end", "gc", "mcf7rt",
+                                  "bin_size"]
+    assert set(bins.chr) == {"1", "2", "X"}
+    assert (bins.end - bins.start == 500_000).all()
+    assert bins.gc.between(0.25, 0.75).all()
+    assert bins.mcf7rt.between(0.0, 1.0).all()
+    # deterministic given the seed
+    again = make_example_bins(chroms=["1", "2", "X"])
+    assert bins.equals(again)
+    # genome-wide at 500kb lands near the reference's 5451 rows
+    full = make_example_bins()
+    assert 5000 < len(full) < 6500
