@@ -20,8 +20,8 @@ COPY tests ./tests
 COPY examples ./examples
 COPY bench.py ./
 
-RUN pip install --no-cache-dir "jax[cpu]" optax pytest scipy scikit-learn \
-        pandas matplotlib seaborn \
+RUN pip install --no-cache-dir "jax[cpu]>=0.7,<0.10" optax pytest scipy \
+        scikit-learn pandas matplotlib seaborn \
     && pip install --no-cache-dir -e .
 
 # gate the image on a green suite, like the reference's Docker build

@@ -99,9 +99,10 @@ class PertConfig:
     # fit into this directory; None disables tracing.
     profile_dir: Optional[str] = None
     # optional genome-smoothed CN decode: Viterbi over loci with this
-    # self-transition probability (the transition matrix the reference
-    # defines but never uses, pert_model.py:260-269); None keeps the
-    # reference's independent per-bin argmax decode.
+    # self-transition probability — a simplified stand-in inspired by
+    # the transition machinery the reference defines but never uses
+    # (pert_model.py:260-269); None keeps the reference's independent
+    # per-bin argmax decode.
     cn_hmm_self_prob: Optional[float] = None
 
     def resolved_iters(self) -> dict:

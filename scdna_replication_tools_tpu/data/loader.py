@@ -87,6 +87,20 @@ def pivot_matrix(
     cn = cn[cn[value_col].notna()
             & cn[cols.cell_col].notna()
             & cn[cols.start_col].notna()]
+    # normalize the start dtype ONCE so both the scatter fast path and the
+    # duplicate-key pivot_table fallback produce identical (int64) column
+    # labels — a float/str start column would otherwise keep its original
+    # labels only on the fallback path (and silently truncate floats on
+    # the fast path)
+    if cn[cols.start_col].dtype != np.int64:
+        starts_num = pd.to_numeric(cn[cols.start_col]).to_numpy()
+        starts_i64 = starts_num.astype(np.int64)
+        if not np.array_equal(starts_i64.astype(starts_num.dtype),
+                              starts_num):
+            raise ValueError(
+                f"column {cols.start_col!r} has non-integral values; "
+                "bin starts must be integral genomic coordinates")
+        cn = cn.assign(**{cols.start_col: starts_i64})
     chr_cat = as_chr_categorical(cn[cols.chr_col])
     known = chr_cat.cat.codes.to_numpy() >= 0
     if not known.all():

@@ -1,17 +1,19 @@
 """Locus-coupled CN decoding: Viterbi over the genome as a batched scan.
 
-The reference *declares* an HMM transition matrix for the CN chain —
-``build_trans_mat`` with self-probability ``t`` and uniform off-diagonal
-mass (reference: pert_model.py:260-269) — but never calls it: its decode
-is an independent per-bin argmax.  This module ships the feature the
-reference left dead, as an opt-in alternative decode that smooths
-single-bin CN flickers with a genome-aware Viterbi pass:
+The reference *declares* an HMM transition machinery for the CN chain —
+``build_trans_mat``, a data-derived transition-count matrix (identity +
+1 + observed CN transitions; reference: pert_model.py:260-269) — but
+never calls it: its decode is an independent per-bin argmax.  This
+module ships an opt-in genome-aware Viterbi decode in that spirit (not a
+reproduction of the unused builder) that smooths single-bin CN flickers:
 
 * emissions are the same per-bin joint logits the independent decode
   uses (models/pert._joint_logits), reduced over the replication axis, so
   the two decodes never disagree about the model;
-* the transition matrix is the reference's intended one: log t on the
-  diagonal, log((1-t)/(P-1)) elsewhere;
+* the transition matrix is a simplified stand-in for the reference's
+  unused count matrix: a single self-probability ``t`` on the diagonal,
+  uniform mass log((1-t)/(P-1)) elsewhere — one interpretable smoothing
+  knob instead of a data-derived estimate;
 * chromosome boundaries break the chain (free transition), since
   adjacent bins on different chromosomes are not physically adjacent;
 * the recursion is a ``lax.scan`` over loci vmapped over cells — the
@@ -31,8 +33,8 @@ import jax.numpy as jnp
 
 def transition_log_probs(P: int, self_prob: float) -> jnp.ndarray:
     """(P, P) log transition matrix: stay with ``self_prob``, switch
-    uniformly otherwise (the reference's intended trans_mat,
-    reference: pert_model.py:260-269)."""
+    uniformly otherwise — a simplified stand-in for the reference's
+    unused data-derived count matrix (reference: pert_model.py:260-269)."""
     off = (1.0 - self_prob) / (P - 1)
     t = jnp.full((P, P), jnp.log(off), jnp.float32)
     return t.at[jnp.arange(P), jnp.arange(P)].set(jnp.log(self_prob))

@@ -522,8 +522,9 @@ def decode_discrete_hmm(spec: PertModelSpec, params: dict, fixed: dict,
     """Genome-smoothed MAP decode: Viterbi over the CN chain.
 
     Opt-in alternative to :func:`decode_discrete` that couples adjacent
-    loci with the transition matrix the reference defined but never used
-    (reference: pert_model.py:260-269) — see ``models.hmm``.  ``restart``
+    loci with a simplified uniform-off-diagonal transition matrix (a
+    stand-in inspired by the machinery the reference defined but never
+    used, pert_model.py:260-269) — see ``models.hmm``.  ``restart``
     is a (loci,) float array with 1.0 wherever a new chromosome starts.
     """
     from scdna_replication_tools_tpu.models.hmm import hmm_decode

@@ -6,9 +6,12 @@
 // groupby machinery per call.  At the 10k-cell x 5.4k-loci benchmark
 // scale that is ~54M scattered writes per pivot and several pivots per
 // run; this kernel does the scatter with raw pointers across N threads
-// (each thread owns a disjoint slice of the *input* triples; duplicate
-// (cell, locus) keys are resolved last-writer-wins, matching the
-// documented one-row-per-key input contract).
+// (each thread owns a disjoint slice of the *input* triples).  Input
+// contract: (cell, locus) keys MUST be unique — with duplicates, two
+// threads may write the same output slot unsynchronised, which is a data
+// race under the C++ memory model and leaves an unspecified winner.
+// data/loader.py enforces this by routing duplicate-key inputs to the
+// pandas pivot_table fallback before ever calling this kernel.
 //
 // Built lazily by native/build.py with `g++ -O3 -shared -fPIC`; loaded
 // via ctypes (no pybind11 in the image).  data/loader.py falls back to a

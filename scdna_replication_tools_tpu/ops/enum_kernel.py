@@ -227,6 +227,11 @@ def enum_loglik(reads, mu, log_pi, phi, lamb, interpret=False):
 
     ``log_pi`` is (cells, loci, P); ``lamb`` is a scalar (no gradient —
     lambda is fixed in the enumerated steps, reference: pert_model.py:801).
+
+    Gradient contract: the VJP returns cotangents for ``mu``, ``log_pi``
+    and ``phi`` only; ``reads`` is observed data and its cotangent is a
+    SILENT ZERO (as is ``lamb``'s).  A caller differentiating w.r.t.
+    ``reads`` gets zeros, not an error — do not treat reads as a latent.
     """
     ll, _ = _enum_fwd(reads, mu, log_pi, phi, lamb, interpret)
     return ll
