@@ -1,0 +1,417 @@
+"""Anchor the JAX objective to the reference's Pyro semantics.
+
+Pyro itself is not installable in this image, so the anchor is the next
+strongest thing: an INDEPENDENT float64 transcription of the reference's
+``model_s`` (reference: pert_model.py:541-646) built on
+``torch.distributions`` — the exact distribution objects Pyro evaluates
+under the hood — with the TraceEnum_ELBO + AutoDelta semantics applied
+by hand:
+
+* AutoDelta guide => ELBO = log-joint density at the point estimates
+  (every Delta's entropy/log-q term is 0);
+* ``config_enumerate`` + TraceEnum_ELBO => the two discrete sites are
+  marginalised exactly, with Pyro's enumeration broadcast layout (cn in
+  dim -3, rep in dim -4 beyond the (loci, cells) plates,
+  reference: pert_model.py:613, 626);
+* pyro.param sites (lambda, beta_stds, tau-with-t_init) contribute no
+  prior term; conditioned sample sites still contribute their log-prob
+  (poutine.condition semantics, reference: pert_model.py:724-729).
+
+Unlike bench.py's torch twin (which mirrors the builder's own math and
+could cancel a shared bug), this oracle is derived line by line from the
+reference file, keeps the reference's (loci, cells) layout, and uses
+torch.distributions for every density — so a parameterisation mistake in
+ops/dists.py or a dropped term in models/pert.py shows up as a value
+mismatch here.
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.distributions as D
+
+import jax.numpy as jnp
+
+from scdna_replication_tools_tpu.models.pert import (
+    PertBatch,
+    PertModelSpec,
+    constrained,
+    decode_discrete,
+    init_params,
+    pert_loss,
+)
+from scdna_replication_tools_tpu.ops.gc import gc_features
+
+torch.set_default_dtype(torch.float64)
+
+
+def _t(x):
+    return torch.as_tensor(np.asarray(x), dtype=torch.float64)
+
+
+def reference_elbo_oracle(values, data_lc, gammas, libs, etas_lc,
+                          P, K, L, *, lamb_is_param, tau_is_param,
+                          t_alpha=None, t_beta=None,
+                          cn_obs_lc=None, rep_obs_lc=None):
+    """-loss of one SVI step of the reference's model_s, float64.
+
+    ``values`` holds the constrained point estimates; all (loci, cells)
+    layout like the reference ('_lc' suffixed args).  Returns a python
+    float: the ELBO (= log-joint at the point estimates, discretes
+    marginalised).
+    """
+    num_loci, num_cells = data_lc.shape
+    a = _t(values["a"]).reshape(1)
+    lamb = _t(values["lamb"]).reshape(())
+    beta_means = _t(values["beta_means"])          # (L, K+1)
+    beta_stds = _t(values["beta_stds"])            # (L, K+1)
+    rho = _t(values["rho"])                        # (loci,)
+    tau = _t(values["tau"])                        # (cells,)
+    u = _t(values["u"])                            # (cells,)
+    betas = _t(values["betas"])                    # (cells, K+1)
+    data = _t(data_lc)
+    gammas = _t(gammas)
+    libs = torch.as_tensor(np.asarray(libs), dtype=torch.long)
+
+    elbo = torch.zeros(())
+
+    # a ~ Gamma(2, 0.2)                            pert_model.py:553
+    elbo = elbo + D.Gamma(torch.tensor([2.0]),
+                          torch.tensor([0.2])).log_prob(a).sum()
+    # lambda: pyro.param => no prior term          pert_model.py:556-557
+    assert lamb_is_param
+    # beta_means ~ Normal(0,1).expand([L, K+1])    pert_model.py:560
+    elbo = elbo + D.Normal(0.0, 1.0).log_prob(beta_means).sum()
+    # beta_stds: pyro.param => no prior term       pert_model.py:561-562
+    # rho ~ Beta(1, 1) per locus                   pert_model.py:574
+    elbo = elbo + D.Beta(torch.tensor([1.0]),
+                         torch.tensor([1.0])).log_prob(rho).sum()
+
+    # tau                                          pert_model.py:580-585
+    if not tau_is_param:
+        if t_alpha is not None:
+            elbo = elbo + D.Beta(_t(t_alpha), _t(t_beta)).log_prob(tau).sum()
+        else:
+            elbo = elbo + D.Beta(torch.tensor(1.5),
+                                 torch.tensor(1.5)).log_prob(tau).sum()
+
+    # cell ploidies feed the u prior (pert_model.py:589-600).  The cn0
+    # branch (:589-590) is simulator-only — run_pert_model never passes
+    # cn0, and step 1 passes neither cn0 nor etas (:743), so step 1 uses
+    # the default ploidy 2.0 even though its cn site is conditioned.
+    if etas_lc is not None:
+        cell_ploidies = _t(np.argmax(etas_lc, axis=2)).mean(dim=0)
+    else:
+        cell_ploidies = torch.ones(num_cells) * 2.0
+    u_guess = data.mean(dim=0) / ((1 + tau) * cell_ploidies)
+    u_stdev = u_guess / 10.0
+    elbo = elbo + D.Normal(u_guess, u_stdev).log_prob(u).sum()
+
+    # betas ~ Normal(beta_means[libs], beta_stds[libs])  pert_model.py:603
+    elbo = elbo + D.Normal(beta_means[libs],
+                           beta_stds[libs]).log_prob(betas).sum()
+
+    # phi = clamp(sigmoid(a (tau - rho)))          pert_model.py:616-623
+    t_diff = tau.reshape(-1, num_cells) - rho.reshape(num_loci, -1)
+    phi = torch.sigmoid(a.reshape(()) * t_diff)
+    phi = torch.clamp(phi, 0.001, 0.999)
+
+    # omega = exp(betas . gc_features(gamma))      pert_model.py:632-633
+    feats = torch.stack([gammas ** i for i in range(K, 0, -1)]
+                        + [torch.ones_like(gammas)], dim=1)
+    gc_feats = feats.reshape(num_loci, 1, K + 1)
+    omega = torch.exp(torch.sum(betas * gc_feats, 2))   # (loci, cells)
+
+    def nb_log_prob(chi):
+        """NB observation term for a given total CN (broadcasts over the
+        enumeration dims), reference: pert_model.py:636-646."""
+        theta = u * chi * omega
+        delta = theta * (1 - lamb) / lamb
+        delta = torch.clamp(delta, min=1.0)
+        return D.NegativeBinomial(total_count=delta,
+                                  probs=lamb).log_prob(data)
+
+    if cn_obs_lc is not None:
+        # step 1: cn and rep observed via poutine.condition — their
+        # log-probs still enter the loss              pert_model.py:724-729
+        cn_o = _t(cn_obs_lc)
+        rep_o = _t(rep_obs_lc)
+        if etas_lc is None:
+            etas = torch.ones(num_loci, num_cells, P)
+        else:
+            etas = _t(etas_lc)
+        pi = _t(values["pi"])
+        elbo = elbo + D.Dirichlet(etas).log_prob(pi).sum()
+        elbo = elbo + D.Categorical(probs=pi).log_prob(cn_o.long()).sum()
+        elbo = elbo + D.Bernoulli(probs=phi).log_prob(rep_o).sum()
+        elbo = elbo + nb_log_prob(cn_o * (1.0 + rep_o)).sum()
+        return float(elbo)
+
+    # step 2/3: pi ~ Dirichlet(etas); cn, rep enumerated in parallel
+    if etas_lc is None:
+        etas = torch.ones(num_loci, num_cells, P)
+    else:
+        etas = _t(etas_lc)
+    pi = _t(values["pi"])                          # (loci, cells, P)
+    elbo = elbo + D.Dirichlet(etas).log_prob(pi).sum()
+
+    # Pyro's parallel-enumeration layout: cn occupies dim -3, rep dim -4
+    # (the first dims beyond max_plate_nesting=2)    pert_model.py:611-626
+    cn_enum = torch.arange(P, dtype=torch.float64).reshape(P, 1, 1)
+    rep_enum = torch.arange(2, dtype=torch.float64).reshape(2, 1, 1, 1)
+    lp_cn = D.Categorical(probs=pi).log_prob(cn_enum)        # (P, l, c)
+    lp_rep = D.Bernoulli(probs=phi).log_prob(rep_enum)       # (2, 1, l, c)
+    chi = cn_enum * (1.0 + rep_enum)                          # (2, P, 1, 1)
+    lp_nb = nb_log_prob(chi)                                  # (2, P, l, c)
+    joint = lp_cn.unsqueeze(0) + lp_rep + lp_nb               # (2, P, l, c)
+    marg = torch.logsumexp(joint.reshape(2 * P, num_loci, num_cells), dim=0)
+    elbo = elbo + marg.sum()
+    return float(elbo)
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+def _problem(rng, num_cells=10, num_loci=40, P=6, K=3, L=1,
+             eta_conc=50.0, step1=False):
+    reads = rng.poisson(40, (num_cells, num_loci)).astype(np.float32)
+    gammas = rng.uniform(0.35, 0.6, num_loci).astype(np.float32)
+    libs = (np.arange(num_cells) % L).astype(np.int32)
+    etas = np.ones((num_cells, num_loci, P), np.float32)
+    states = rng.integers(1, 4, (num_cells, num_loci))
+    np.put_along_axis(etas, states[..., None], eta_conc, axis=-1)
+    cn_obs = rep_obs = None
+    if step1:
+        cn_obs = states.astype(np.float32)
+        rep_obs = (np.arange(num_cells) % 2)[:, None] * \
+            np.ones((1, num_loci), np.float32)
+    return reads, gammas, libs, etas, cn_obs, rep_obs
+
+
+def _randomized_params(rng, spec, batch, fixed, t_init):
+    """init_params + random perturbation so no term sits at a special
+    point (0 logits, prior means) where a dropped factor could hide."""
+    params = init_params(spec, batch, fixed, t_init=t_init)
+    leaves, treedef = __import__("jax").tree_util.tree_flatten(params)
+    leaves = [jnp.asarray(
+        np.asarray(x) + rng.normal(0, 0.05, np.shape(x)).astype(np.float32))
+        for x in leaves]
+    return treedef.unflatten(leaves)
+
+
+def _oracle_values(spec, params, fixed, reads_shape):
+    """Constrained site values as numpy, plus the (loci, cells[, P])
+    transposes the oracle expects."""
+    c = constrained(spec, params, fixed)
+    vals = {k: np.asarray(v, np.float64) for k, v in c.items()
+            if k not in ("log_pi", "pi")}
+    vals["pi"] = np.transpose(np.asarray(c["pi"], np.float64), (1, 0, 2))
+    # renormalise in float64: the oracle's Dirichlet.log_prob validates
+    # the simplex at float64 precision
+    vals["pi"] /= vals["pi"].sum(axis=-1, keepdims=True)
+    return vals
+
+
+def _compare(spec, batch, fixed, t_init, rng, **oracle_kwargs):
+    params = _randomized_params(rng, spec, batch, fixed, t_init)
+    jax_elbo = -float(pert_loss(spec, params, fixed, batch))
+    vals = _oracle_values(spec, params, fixed, batch.reads.shape)
+    etas_lc = None if batch.etas is None else \
+        np.transpose(np.asarray(batch.etas, np.float64), (1, 0, 2))
+    cn_lc = None if batch.cn_obs is None else np.asarray(batch.cn_obs).T
+    rep_lc = None if batch.rep_obs is None else np.asarray(batch.rep_obs).T
+    ref_elbo = reference_elbo_oracle(
+        vals, np.asarray(batch.reads, np.float64).T,
+        np.asarray(batch.gamma_feats)[:, -2],  # linear column == gamma
+        np.asarray(batch.libs), etas_lc, spec.P, spec.K, spec.L,
+        cn_obs_lc=cn_lc, rep_obs_lc=rep_lc, **oracle_kwargs)
+    # float32 forward pass vs float64 oracle: tolerance scales with the
+    # magnitude of the largest accumulated term
+    scale = max(abs(ref_elbo), 1.0)
+    assert abs(jax_elbo - ref_elbo) < 3e-5 * scale, (
+        f"jax={jax_elbo:.3f} oracle={ref_elbo:.3f} "
+        f"diff={jax_elbo - ref_elbo:.5f}")
+    return params
+
+
+def _batch_from(spec, reads, gammas, libs, etas, cn_obs=None, rep_obs=None,
+                t_alpha=None, t_beta=None):
+    return PertBatch(
+        reads=jnp.asarray(reads),
+        libs=jnp.asarray(libs),
+        gamma_feats=gc_features(jnp.asarray(gammas), spec.K),
+        mask=jnp.ones((reads.shape[0],), jnp.float32),
+        etas=None if etas is None else jnp.asarray(etas),
+        cn_obs=None if cn_obs is None else jnp.asarray(cn_obs),
+        rep_obs=None if rep_obs is None else jnp.asarray(rep_obs),
+        t_alpha=None if t_alpha is None else jnp.asarray(t_alpha),
+        t_beta=None if t_beta is None else jnp.asarray(t_beta),
+    )
+
+
+# ---------------------------------------------------------------------------
+# tests
+# ---------------------------------------------------------------------------
+
+def test_step2_production_config_matches_reference():
+    """Step 2 as run_pert_model runs it: beta_means conditioned, lambda
+    fixed, tau a param from guess_times (reference: pert_model.py:777-816)."""
+    rng = np.random.default_rng(0)
+    reads, gammas, libs, etas, _, _ = _problem(rng)
+    spec = PertModelSpec(P=6, K=3, L=1, tau_mode="param",
+                         cond_beta_means=True, fixed_lamb=True)
+    batch = _batch_from(spec, reads, gammas, libs, etas)
+    fixed = {"beta_means": jnp.asarray(
+                 rng.normal(0, 0.3, (1, 4)).astype(np.float32)),
+             "lamb": jnp.asarray(0.75, jnp.float32)}
+    _compare(spec, batch, fixed, rng.uniform(0.2, 0.8, 10).astype(np.float32),
+             rng, lamb_is_param=True, tau_is_param=True)
+
+
+def test_step2_free_sites_match_reference():
+    """All sample sites free: beta_means sampled, tau ~ Beta(1.5, 1.5)
+    (reference: pert_model.py:560, 585)."""
+    rng = np.random.default_rng(1)
+    reads, gammas, libs, etas, _, _ = _problem(rng)
+    spec = PertModelSpec(P=6, K=3, L=1, tau_mode="beta_default",
+                         fixed_lamb=True)
+    batch = _batch_from(spec, reads, gammas, libs, etas)
+    fixed = {"lamb": jnp.asarray(0.6, jnp.float32)}
+    _compare(spec, batch, fixed, None, rng,
+             lamb_is_param=True, tau_is_param=False)
+
+
+def test_step2_beta_prior_tau_matches_reference():
+    """tau ~ Beta(t_alpha, t_beta) branch (reference: pert_model.py:580-581,
+    used by step 3 via guess_times posteriors)."""
+    rng = np.random.default_rng(2)
+    reads, gammas, libs, etas, _, _ = _problem(rng)
+    t_alpha = rng.uniform(1.0, 3.0, 10).astype(np.float32)
+    t_beta = rng.uniform(1.0, 3.0, 10).astype(np.float32)
+    spec = PertModelSpec(P=6, K=3, L=1, tau_mode="beta_prior",
+                         cond_beta_means=True, fixed_lamb=True)
+    batch = _batch_from(spec, reads, gammas, libs, etas,
+                        t_alpha=t_alpha, t_beta=t_beta)
+    fixed = {"beta_means": jnp.zeros((1, 4), jnp.float32),
+             "lamb": jnp.asarray(0.75, jnp.float32)}
+    _compare(spec, batch, fixed, None, rng,
+             lamb_is_param=True, tau_is_param=False,
+             t_alpha=t_alpha, t_beta=t_beta)
+
+
+def test_step1_observed_discretes_match_reference():
+    """Step 1: cn/rep conditioned to the G1/G2-doubled training data,
+    etas NOT passed (uniform Dirichlet, ploidy 2.0) — exactly how
+    run_pert_model invokes it (reference: pert_model.py:718-743)."""
+    rng = np.random.default_rng(3)
+    reads, gammas, libs, _, cn_obs, rep_obs = _problem(rng, step1=True)
+    # lambda as a live param (interval-transformed), as step 1 fits it
+    # (reference: pert_model.py:556-557)
+    spec = PertModelSpec(P=6, K=3, L=1, tau_mode="beta_default", step1=True,
+                         fixed_lamb=False)
+    batch = _batch_from(spec, reads, gammas, libs, None, cn_obs, rep_obs)
+    _compare(spec, batch, {}, None, rng,
+             lamb_is_param=True, tau_is_param=False)
+
+
+def test_multilibrary_matches_reference():
+    """L=2 libraries: betas indexed per cell through beta_means[libs] /
+    beta_stds[libs] (reference: pert_model.py:560-562, 603)."""
+    rng = np.random.default_rng(4)
+    reads, gammas, libs, etas, _, _ = _problem(rng, num_cells=12, L=2)
+    spec = PertModelSpec(P=6, K=3, L=2, tau_mode="param",
+                         cond_beta_means=True, fixed_lamb=True)
+    batch = _batch_from(spec, reads, gammas, libs, etas)
+    fixed = {"beta_means": jnp.asarray(
+                 rng.normal(0, 0.3, (2, 4)).astype(np.float32)),
+             "lamb": jnp.asarray(0.75, jnp.float32)}
+    _compare(spec, batch, fixed,
+             rng.uniform(0.2, 0.8, 12).astype(np.float32), rng,
+             lamb_is_param=True, tau_is_param=True)
+
+
+def test_high_concentration_loss_differences_match():
+    """At the production eta concentration (1e6, pert_model.py:41) the
+    Dirichlet normaliser dwarfs float32 absolute precision, so compare
+    LOSS DIFFERENCES between two parameter points (constants cancel) —
+    the part SVI gradients actually see."""
+    rng = np.random.default_rng(5)
+    reads, gammas, libs, etas, _, _ = _problem(rng, eta_conc=1e6)
+    spec = PertModelSpec(P=6, K=3, L=1, tau_mode="param",
+                         cond_beta_means=True, fixed_lamb=True)
+    batch = _batch_from(spec, reads, gammas, libs, etas)
+    fixed = {"beta_means": jnp.zeros((1, 4), jnp.float32),
+             "lamb": jnp.asarray(0.75, jnp.float32)}
+    t_init = rng.uniform(0.2, 0.8, 10).astype(np.float32)
+
+    jax_vals, ref_vals = [], []
+    for seed in (10, 11):
+        prng = np.random.default_rng(seed)
+        params = _randomized_params(prng, spec, batch, fixed, t_init)
+        jax_vals.append(-float(pert_loss(spec, params, fixed, batch)))
+        vals = _oracle_values(spec, params, fixed, batch.reads.shape)
+        ref_vals.append(reference_elbo_oracle(
+            vals, np.asarray(batch.reads, np.float64).T,
+            gammas, libs,
+            np.transpose(np.asarray(etas, np.float64), (1, 0, 2)),
+            spec.P, spec.K, spec.L, lamb_is_param=True, tau_is_param=True))
+    d_jax = jax_vals[0] - jax_vals[1]
+    d_ref = ref_vals[0] - ref_vals[1]
+    # (etas-1)*log_pi carries 1e6-scale coefficients, so float32
+    # log_softmax noise (~1e-7 relative) leaves ~0.1% error on the
+    # parameter-dependent difference — the bound is precision, not
+    # semantics (the exact-value tests above pin those at eta=50)
+    assert abs(d_jax - d_ref) < 3e-3 * max(abs(d_ref), 1.0), (
+        f"jax diff={d_jax:.3f} oracle diff={d_ref:.3f}")
+
+
+def test_decode_agrees_with_oracle_argmax():
+    """infer_discrete(temperature=0) equivalence: the (cn, rep) argmax of
+    the oracle's enumerated joint must match decode_discrete
+    (reference: pert_model.py:824-827)."""
+    rng = np.random.default_rng(6)
+    reads, gammas, libs, etas, _, _ = _problem(rng)
+    spec = PertModelSpec(P=6, K=3, L=1, tau_mode="param",
+                         cond_beta_means=True, fixed_lamb=True)
+    batch = _batch_from(spec, reads, gammas, libs, etas)
+    fixed = {"beta_means": jnp.zeros((1, 4), jnp.float32),
+             "lamb": jnp.asarray(0.75, jnp.float32)}
+    params = _randomized_params(
+        rng, spec, batch, fixed, rng.uniform(0.2, 0.8, 10).astype(np.float32))
+
+    cn_map, rep_map, _ = decode_discrete(spec, params, fixed, batch)
+
+    # oracle joint, float64, reference layout
+    vals = _oracle_values(spec, params, fixed, batch.reads.shape)
+    pi = torch.as_tensor(vals["pi"])                       # (l, c, P)
+    a = torch.as_tensor(vals["a"]).reshape(())
+    tau = torch.as_tensor(vals["tau"])
+    rho = torch.as_tensor(vals["rho"])
+    u = torch.as_tensor(vals["u"])
+    betas = torch.as_tensor(vals["betas"])
+    lamb = torch.as_tensor(vals["lamb"]).reshape(())
+    g = torch.as_tensor(np.asarray(gammas, np.float64))
+    num_loci, num_cells = pi.shape[0], pi.shape[1]
+    phi = torch.clamp(torch.sigmoid(a * (tau.reshape(1, -1)
+                                         - rho.reshape(-1, 1))), 0.001, 0.999)
+    feats = torch.stack([g ** i for i in range(spec.K, 0, -1)]
+                        + [torch.ones_like(g)], dim=1)
+    omega = torch.exp(torch.sum(betas * feats.reshape(num_loci, 1, -1), 2))
+    cn_enum = torch.arange(spec.P, dtype=torch.float64).reshape(spec.P, 1, 1)
+    rep_enum = torch.arange(2, dtype=torch.float64).reshape(2, 1, 1, 1)
+    chi = cn_enum * (1.0 + rep_enum)
+    theta = u * chi * omega
+    delta = torch.clamp(theta * (1 - lamb) / lamb, min=1.0)
+    lp_nb = D.NegativeBinomial(total_count=delta, probs=lamb).log_prob(
+        torch.as_tensor(np.asarray(batch.reads, np.float64).T))
+    joint = (torch.log(pi).permute(2, 0, 1).unsqueeze(0)
+             + D.Bernoulli(probs=phi).log_prob(rep_enum) + lp_nb)
+    flat = joint.reshape(2 * spec.P, num_loci, num_cells)
+    best = torch.argmax(flat, dim=0)          # index = rep * P + cn
+    oracle_cn = (best % spec.P).numpy().T
+    oracle_rep = (best // spec.P).numpy().T
+
+    agree = np.mean((np.asarray(cn_map) == oracle_cn)
+                    & (np.asarray(rep_map) == oracle_rep))
+    assert agree > 0.99, f"decode agreement {agree:.4f}"

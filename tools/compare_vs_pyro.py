@@ -1,0 +1,150 @@
+"""Head-to-head: JAX framework vs the actual Pyro reference.
+
+Run where BOTH packages are importable (pyro-ppl is not installable in
+the build image — the in-repo anchor is tests/test_reference_oracle.py's
+torch.distributions transcription; this script is the full-fidelity
+check for CI/dev machines with network access):
+
+    pip install pyro-ppl==1.8.2 "torch>=1.12"
+    pip install git+https://github.com/shahcompbio/scdna_replication_tools
+    python tools/compare_vs_pyro.py --max-iter 300 --out pyro_compare.json
+
+It simulates one chr1-scale workload (2 clones, one CNA) with the JAX
+simulator, fits BOTH implementations on the identical long-form input
+(cn_prior_method='g1_clones', the reference tutorial's configuration),
+and reports:
+
+* final step-2 loss of each (matched-ELBO check, reference:
+  pert_model.py:792-816 vs infer/runner.py);
+* cn/rep decode agreement between the two, and each vs simulator truth;
+* per-cell tau correlation between the two.
+
+The JSON it writes is suitable for checking in as a recorded fixture.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+import pandas as pd
+
+
+def make_workload(num_cells=40, num_loci=150, seed=11):
+    """Long-form S + G1 frames with simulated NB reads (JAX simulator)."""
+    from scdna_replication_tools_tpu.models.simulator import pert_simulator
+
+    rng = np.random.default_rng(seed)
+    starts = (np.arange(num_loci) * 500_000).astype(np.int64)
+    gc = np.clip(0.45 + 0.08 * np.sin(np.arange(num_loci) / 9.0)
+                 + rng.normal(0, 0.02, num_loci), 0.3, 0.65)
+    rt = 0.5 + 0.45 * np.sin(np.arange(num_loci) / 15.0 + 1.0)
+
+    def cells(prefix, n, clone, cn_profile):
+        out = []
+        for i in range(n):
+            out.append(pd.DataFrame({
+                "cell_id": f"{prefix}_{clone}_{i}", "chr": "1",
+                "start": starts, "end": starts + 500_000, "gc": gc,
+                "mcf7rt": rt, "library_id": "LIB0", "clone_id": clone,
+                "true_somatic_cn": cn_profile}))
+        return out
+
+    cn_a = np.full(num_loci, 2.0)
+    cn_a[:40] = 3.0
+    cn_b = np.full(num_loci, 2.0)
+    half = num_cells // 2
+    cn_s = pd.concat(cells("s", half, "A", cn_a) + cells("s", half, "B", cn_b),
+                     ignore_index=True)
+    cn_g = pd.concat(cells("g", half, "A", cn_a) + cells("g", half, "B", cn_b),
+                     ignore_index=True)
+    cn_s, cn_g = pert_simulator(
+        cn_s, cn_g, num_reads=50_000, rt_cols=["mcf7rt", "mcf7rt"],
+        clones=["A", "B"], lamb=0.75, betas=[0.5, 0.0], a=10.0,
+        gc_col="gc", input_cn_col="true_somatic_cn")
+    for df in (cn_s, cn_g):
+        df["reads"] = df["true_reads_norm"]
+        df["state"] = df["true_somatic_cn"].astype(int)
+        df["copy"] = df["true_somatic_cn"]
+    return cn_s, cn_g
+
+
+def fit_jax(cn_s, cn_g, max_iter):
+    from scdna_replication_tools_tpu.api import scRT
+
+    scrt = scRT(cn_s.copy(), cn_g.copy(), input_col="reads",
+                clone_col="clone_id", assign_col="copy", rt_prior_col=None,
+                cn_state_col="state", gc_col="gc",
+                cn_prior_method="g1_clones", max_iter=max_iter)
+    out_s, supp_s, out_g, supp_g = scrt.infer(level="pert")
+    loss = supp_s.loc[supp_s["param"] == "loss_s", "value"].astype(float)
+    return out_s, float(loss.iloc[-1])
+
+
+def fit_pyro(cn_s, cn_g, max_iter):
+    from scdna_replication_tools.infer_scRT import scRT
+
+    scrt = scRT(cn_s.copy(), cn_g.copy(), input_col="reads",
+                clone_col="clone_id", assign_col="copy", rt_prior_col=None,
+                cn_state_col="state", gc_col="gc",
+                cn_prior_method="g1_clones", max_iter=max_iter)
+    out_s, supp_s, out_g, supp_g = scrt.infer(level="pert")
+    loss = supp_s.loc[supp_s["param"] == "loss_s", "value"].astype(float) \
+        if "param" in supp_s.columns else \
+        supp_s["loss_s"].dropna().astype(float)
+    return out_s, float(loss.iloc[-1])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-iter", type=int, default=300)
+    ap.add_argument("--cells", type=int, default=40)
+    ap.add_argument("--loci", type=int, default=150)
+    ap.add_argument("--out", default="pyro_compare.json")
+    args = ap.parse_args()
+
+    try:
+        import scdna_replication_tools  # noqa: F401
+        import pyro  # noqa: F401
+    except ImportError as exc:
+        print(f"SKIP: reference/pyro not importable ({exc}); install "
+              "pyro-ppl and shahcompbio/scdna_replication_tools first",
+              file=sys.stderr)
+        sys.exit(0)
+
+    cn_s, cn_g = make_workload(args.cells, args.loci)
+
+    jax_out, jax_loss = fit_jax(cn_s, cn_g, args.max_iter)
+    ref_out, ref_loss = fit_pyro(cn_s, cn_g, args.max_iter)
+
+    key = ["cell_id", "chr", "start"]
+    merged = jax_out.merge(
+        ref_out[key + ["model_rep_state", "model_cn_state", "model_tau"]],
+        on=key, suffixes=("", "_ref"))
+
+    tau = merged.groupby("cell_id").agg(
+        a=("model_tau", "first"), b=("model_tau_ref", "first"))
+    report = {
+        "workload": {"cells": args.cells, "loci": args.loci,
+                     "max_iter": args.max_iter},
+        "jax_final_loss_s": jax_loss,
+        "pyro_final_loss_s": ref_loss,
+        "rep_agreement": float(
+            (merged.model_rep_state == merged.model_rep_state_ref).mean()),
+        "cn_agreement": float(
+            (merged.model_cn_state == merged.model_cn_state_ref).mean()),
+        "tau_correlation": float(np.corrcoef(tau.a, tau.b)[0, 1]),
+        "jax_rep_acc_vs_truth": float(
+            (merged.model_rep_state == merged.true_rep).mean()),
+        "pyro_rep_acc_vs_truth": float(
+            (merged.model_rep_state_ref == merged.true_rep).mean()),
+    }
+    print(json.dumps(report, indent=2))
+    with open(args.out, "w") as fh:
+        json.dump(report, fh, indent=2)
+
+
+if __name__ == "__main__":
+    main()
