@@ -1,11 +1,20 @@
-"""Step-boundary checkpointing of fitted parameter pytrees.
+"""Checkpointing of fitted parameter pytrees + optimizer state.
 
 The reference has no checkpoint/resume at all — learned state crosses the
 three SVI steps only in-memory (reference: pert_model.py:772-787, 836-851).
-Step boundaries are natural checkpoints, so the TPU runner persists the
-fitted (unconstrained) parameter dict, loss history and RNG-free metadata
-after each step as a flat ``.npz``; a rerun resumes from the last
-completed step.
+The TPU runner persists, after each step, the fitted (unconstrained)
+parameter dict, the Adam optimiser state, the loss history and a small
+meta record (iterations run, converged flag) as a flat ``.npz``.
+
+Resume semantics (see ``runner.PertInference._fit``):
+
+* a COMPLETED step (converged, NaN-aborted, or out of budget) is restored
+  as-is and not refit;
+* a PARTIAL step (stopped early, e.g. a smaller ``max_iter`` budget or a
+  killed run whose latest boundary file was partial) resumes optimisation
+  from the saved iteration with Adam moments intact — the resumed
+  trajectory is bit-identical to an uninterrupted run because the
+  compiled loop is deterministic given params + opt state + loss history.
 """
 
 from __future__ import annotations
@@ -17,11 +26,24 @@ import numpy as np
 
 
 def save_step(checkpoint_dir: str, step: str, params: dict,
-              losses: np.ndarray, extra: Optional[dict] = None) -> str:
+              losses: np.ndarray, extra: Optional[dict] = None,
+              opt_state=None, num_iters: Optional[int] = None,
+              converged: bool = True, nan_abort: bool = False) -> str:
     os.makedirs(checkpoint_dir, exist_ok=True)
     path = os.path.join(checkpoint_dir, f"pert_{step}.npz")
     flat = {f"param.{k}": np.asarray(v) for k, v in params.items()}
     flat["losses"] = np.asarray(losses)
+    flat["meta.num_iters"] = np.asarray(
+        num_iters if num_iters is not None else len(losses))
+    flat["meta.converged"] = np.asarray(bool(converged))
+    flat["meta.nan_abort"] = np.asarray(bool(nan_abort))
+    if opt_state is not None:
+        # flatten generically; the reader rebuilds the treedef from a
+        # fresh optax init over the restored params (same structure)
+        import jax
+        leaves = jax.tree_util.tree_leaves(opt_state)
+        for i, leaf in enumerate(leaves):
+            flat[f"opt.{i}"] = np.asarray(leaf)
     for k, v in (extra or {}).items():
         flat[f"extra.{k}"] = np.asarray(v)
     np.savez(path, **flat)
@@ -29,7 +51,11 @@ def save_step(checkpoint_dir: str, step: str, params: dict,
 
 
 def load_step(checkpoint_dir: str, step: str):
-    """Returns (params, losses, extra) or None if the checkpoint is absent."""
+    """Returns (params, losses, extra) or None if the checkpoint is absent.
+
+    ``extra`` carries the ``meta.*`` record and any ``opt.N`` optimiser
+    leaves (rebuild the pytree with :func:`restore_opt_state`).
+    """
     path = os.path.join(checkpoint_dir, f"pert_{step}.npz")
     if not os.path.exists(path):
         return None
@@ -38,4 +64,24 @@ def load_step(checkpoint_dir: str, step: str):
               if k.startswith("param.")}
     extra = {k[len("extra."):]: data[k] for k in data.files
              if k.startswith("extra.")}
+    for k in data.files:
+        if k.startswith("meta.") or k.startswith("opt."):
+            extra[k] = data[k]
     return params, data["losses"], extra
+
+
+def restore_opt_state(extra: dict, params: dict, learning_rate: float,
+                      b1: float, b2: float):
+    """Rebuild the optax state pytree from flat ``opt.N`` leaves, or None
+    when the checkpoint predates optimiser-state persistence."""
+    opt_keys = sorted((k for k in extra if k.startswith("opt.")),
+                      key=lambda k: int(k.split(".", 1)[1]))
+    if not opt_keys:
+        return None
+    import jax
+    from scdna_replication_tools_tpu.infer.svi import make_opt_state
+
+    template = make_opt_state(params, learning_rate, b1, b2)
+    treedef = jax.tree_util.tree_structure(template)
+    leaves = [extra[k] for k in opt_keys]
+    return jax.tree_util.tree_unflatten(treedef, leaves)

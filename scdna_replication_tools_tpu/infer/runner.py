@@ -254,17 +254,31 @@ class PertInference:
     def _fit(self, spec, batch, fixed, t_init, max_iter, min_iter,
              step_name) -> StepOutput:
         cfg = self.config
+        params0 = opt_state0 = losses_prefix = None
         if cfg.checkpoint_dir:
             restored = ckpt.load_step(cfg.checkpoint_dir, step_name)
             if restored is not None:
-                params, losses, _ = restored
+                params, losses, extra = restored
                 params = {k: jnp.asarray(v) for k, v in params.items()}
-                fit = FitResult(params=params, losses=losses,
-                                num_iters=len(losses), converged=True,
-                                nan_abort=False)
-                return StepOutput(fit, spec, fixed, batch, 0.0)
+                num_iters = int(extra.get("meta.num_iters", len(losses)))
+                converged = bool(extra.get("meta.converged", True))
+                nan_abort = bool(extra.get("meta.nan_abort", False))
+                if converged or nan_abort or num_iters >= max_iter:
+                    # completed step: restore as-is, no refit
+                    fit = FitResult(params=params, losses=losses,
+                                    num_iters=num_iters, converged=converged,
+                                    nan_abort=nan_abort)
+                    return StepOutput(fit, spec, fixed, batch, 0.0)
+                # partial step: resume from the saved iteration with Adam
+                # moments intact (exact continuation of the trajectory)
+                params0 = params
+                opt_state0 = ckpt.restore_opt_state(
+                    extra, params, cfg.learning_rate, cfg.adam_b1,
+                    cfg.adam_b2)
+                losses_prefix = np.asarray(losses)[:num_iters]
 
-        params0 = init_params(spec, batch, fixed, t_init=t_init)
+        if params0 is None:
+            params0 = init_params(spec, batch, fixed, t_init=t_init)
         batch, params0 = self._maybe_shard(batch, params0)
         mesh = self._mesh if spec.enum_impl in ("pallas",
                                                 "pallas_interpret") else None
@@ -278,7 +292,9 @@ class PertInference:
                           max_iter=max_iter, min_iter=min_iter,
                           rel_tol=cfg.rel_tol,
                           learning_rate=cfg.learning_rate,
-                          b1=cfg.adam_b1, b2=cfg.adam_b2)
+                          b1=cfg.adam_b1, b2=cfg.adam_b2,
+                          opt_state0=opt_state0,
+                          losses_prefix=losses_prefix)
         wall = time.perf_counter() - t0
         profiling.log_step_summary(step_name, fit, wall,
                                    int(batch.reads.shape[0]))
@@ -286,7 +302,12 @@ class PertInference:
         if cfg.checkpoint_dir:
             ckpt.save_step(cfg.checkpoint_dir, step_name,
                            jax.tree_util.tree_map(np.asarray, fit.params),
-                           fit.losses)
+                           fit.losses,
+                           opt_state=jax.tree_util.tree_map(
+                               np.asarray, fit.opt_state),
+                           num_iters=fit.num_iters,
+                           converged=fit.converged,
+                           nan_abort=fit.nan_abort)
         return StepOutput(fit, spec, fixed, batch, wall)
 
     def run_step1(self) -> StepOutput:

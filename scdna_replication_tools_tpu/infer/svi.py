@@ -38,6 +38,8 @@ class FitResult:
     num_iters: int
     converged: bool
     nan_abort: bool
+    opt_state: object = None  # final optax state (device pytree) — persist
+                              # it to make a partial fit exactly resumable
 
 
 def _window_stat(losses, i, win_size):
@@ -51,12 +53,11 @@ def _window_stat(losses, i, win_size):
 
 @functools.partial(jax.jit, static_argnames=("loss_fn", "max_iter", "min_iter",
                                              "lr", "b1", "b2"))
-def _run_fit(loss_fn: Callable, params0: dict, loss_args: tuple,
+def _run_fit(loss_fn: Callable, params0: dict, opt_state0, losses0,
+             i0, loss_args: tuple,
              max_iter: int, min_iter: int, rel_tol: float,
              lr: float, b1: float, b2: float):
     tx = optax.adam(learning_rate=lr, b1=b1, b2=b2)
-    opt_state0 = tx.init(params0)
-    losses0 = jnp.zeros((max_iter,), jnp.float32)
 
     value_and_grad = jax.value_and_grad(loss_fn)
 
@@ -79,23 +80,46 @@ def _run_fit(loss_fn: Callable, params0: dict, loss_args: tuple,
         done = jnp.logical_or(is_nan, converged)
         return (i + 1, params, opt_state, losses, done, converged, is_nan)
 
-    init = (jnp.asarray(0), params0, opt_state0, losses0,
+    init = (jnp.asarray(i0), params0, opt_state0, losses0,
             jnp.asarray(False), jnp.asarray(False), jnp.asarray(False))
-    i, params, _, losses, _, converged, is_nan = jax.lax.while_loop(
+    i, params, opt_state, losses, _, converged, is_nan = jax.lax.while_loop(
         cond, body, init)
-    return i, params, losses, converged, is_nan
+    return i, params, opt_state, losses, converged, is_nan
+
+
+def make_opt_state(params: dict, learning_rate: float = 0.05,
+                   b1: float = 0.8, b2: float = 0.99):
+    """Fresh Adam state for ``params`` — also the treedef donor when
+    restoring a checkpointed state from flat leaves."""
+    return optax.adam(learning_rate=learning_rate, b1=b1, b2=b2).init(params)
 
 
 def fit_map(loss_fn: Callable, params0: dict, loss_args: tuple = (),
             max_iter: int = 2000, min_iter: int = 100, rel_tol: float = 1e-6,
             learning_rate: float = 0.05, b1: float = 0.8, b2: float = 0.99,
+            opt_state0=None, losses_prefix: Optional[np.ndarray] = None,
             ) -> FitResult:
     """Fit ``params`` by MAP ascent of ``-loss_fn`` with reference semantics.
 
     ``loss_fn(params, *loss_args) -> scalar loss`` must be jit-traceable.
+
+    Resume: pass the ``opt_state`` of a previous partial FitResult plus
+    its ``losses`` as ``losses_prefix`` — optimisation continues from
+    iteration ``len(losses_prefix)`` with Adam moments intact, so an
+    interrupted fit reproduces the uninterrupted trajectory exactly (the
+    loop is deterministic given params + opt state + loss history).
     """
-    i, params, losses, converged, is_nan = _run_fit(
-        loss_fn, params0, loss_args, int(max_iter), int(min_iter),
+    if opt_state0 is None:
+        opt_state0 = make_opt_state(params0, learning_rate, b1, b2)
+    i0 = 0
+    losses0 = jnp.zeros((max_iter,), jnp.float32)
+    if losses_prefix is not None and len(losses_prefix) > 0:
+        i0 = min(int(len(losses_prefix)), int(max_iter))
+        losses0 = losses0.at[:i0].set(
+            jnp.asarray(losses_prefix[:i0], jnp.float32))
+    i, params, opt_state, losses, converged, is_nan = _run_fit(
+        loss_fn, params0, opt_state0, losses0, i0, loss_args,
+        int(max_iter), int(min_iter),
         float(rel_tol), float(learning_rate), float(b1), float(b2))
     n = int(i)
     return FitResult(
@@ -104,4 +128,5 @@ def fit_map(loss_fn: Callable, params0: dict, loss_args: tuple = (),
         num_iters=n,
         converged=bool(converged),
         nan_abort=bool(is_nan),
+        opt_state=opt_state,
     )
