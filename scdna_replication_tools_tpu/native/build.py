@@ -28,22 +28,26 @@ def _build_dir() -> str:
     return os.path.join(os.path.dirname(__file__), "_build")
 
 
+_SOURCES = ("pivot.cpp", "segment.cpp")
+
+
 def _so_path() -> str:
     tag = sysconfig.get_config_var("SOABI") or "generic"
-    return os.path.join(_build_dir(), f"pivot.{tag}.so")
+    return os.path.join(_build_dir(), f"native.{tag}.so")
 
 
 def _compile() -> Optional[str]:
-    src = os.path.join(os.path.dirname(__file__), "pivot.cpp")
+    srcs = [os.path.join(os.path.dirname(__file__), s) for s in _SOURCES]
     out = _so_path()
-    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+    if os.path.exists(out) and all(
+            os.path.getmtime(out) >= os.path.getmtime(s) for s in srcs):
         return out
     os.makedirs(_build_dir(), exist_ok=True)
     # compile to a temp path + atomic rename so a concurrent process can
     # never dlopen a half-written library
     tmp = f"{out}.tmp.{os.getpid()}"
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           src, "-o", tmp]
+           *srcs, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, out)
@@ -72,10 +76,12 @@ def get_native_lib() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(path)
             lib.scatter_pivot_f32
             lib.gather_melt_f32
+            lib.batch_bkps_f64
         except (OSError, AttributeError) as exc:
-            # stale/foreign binary (e.g. built on another ABI): rebuild
-            # once from source, else degrade to the NumPy fallback
-            logger.info("native pivot load failed (%s); rebuilding", exc)
+            # stale/foreign binary (e.g. built on another ABI, or predates
+            # a newly added kernel): rebuild once from source, else degrade
+            # to the NumPy fallback
+            logger.info("native lib load failed (%s); rebuilding", exc)
             try:
                 os.unlink(path)
             except OSError:
@@ -87,11 +93,13 @@ def get_native_lib() -> Optional[ctypes.CDLL]:
                 lib = ctypes.CDLL(path)
                 lib.scatter_pivot_f32
                 lib.gather_melt_f32
+                lib.batch_bkps_f64
             except (OSError, AttributeError) as exc2:
-                logger.info("native pivot unavailable (%s); using NumPy "
+                logger.info("native lib unavailable (%s); using NumPy "
                             "fallback", exc2)
                 return None
         i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
         f32p = ctypes.POINTER(ctypes.c_float)
         f64p = ctypes.POINTER(ctypes.c_double)
         lib.scatter_pivot_f32.argtypes = [
@@ -102,6 +110,10 @@ def get_native_lib() -> Optional[ctypes.CDLL]:
             f32p, i32p, i32p, ctypes.c_int64, ctypes.c_int64, f32p,
             ctypes.c_int32]
         lib.gather_melt_f32.restype = None
+        lib.batch_bkps_f64.argtypes = [
+            f64p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int32, i64p, ctypes.c_int32]
+        lib.batch_bkps_f64.restype = None
         _LIB = lib
         return _LIB
 
