@@ -33,7 +33,30 @@ _SOURCES = ("pivot.cpp", "segment.cpp")
 
 def _so_path() -> str:
     tag = sysconfig.get_config_var("SOABI") or "generic"
-    return os.path.join(_build_dir(), f"native.{tag}.so")
+    # -march=native binaries must never be reused on a different CPU
+    # (dlopen would succeed and then SIGILL at call time on a host
+    # without the build CPU's ISA extensions — e.g. NFS-shared home
+    # dirs on heterogeneous clusters), so key the cache by the CPU
+    # flag set as well as the Python ABI.
+    import hashlib
+    import platform
+
+    cpu = platform.machine()
+    flags = _cpu_flags()
+    isa = hashlib.sha1((cpu + flags).encode()).hexdigest()[:10]
+    return os.path.join(_build_dir(), f"native.{tag}.{isa}.so")
+
+
+def _cpu_flags() -> str:
+    """The CPU feature list, or '' when no source exists (non-Linux)."""
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith("flags") or line.startswith("Features"):
+                    return line
+    except OSError:
+        pass
+    return ""
 
 
 def _compile() -> Optional[str]:
@@ -46,8 +69,28 @@ def _compile() -> Optional[str]:
     # compile to a temp path + atomic rename so a concurrent process can
     # never dlopen a half-written library
     tmp = f"{out}.tmp.{os.getpid()}"
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           *srcs, "-o", tmp]
+    # -ffp-contract=off: the segment kernel's exact-division costs must
+    # round IDENTICALLY to the NumPy oracle (pipeline/segment.py) — FMA
+    # contraction of e.g. the s2 prefix sum would shift costs by 1 ulp
+    # and break tie-for-tie parity between the batch and loop engines.
+    #
+    # -march=native only when the cache key can actually see the CPU
+    # feature set (_cpu_flags); otherwise a tuned .so could be silently
+    # reused on a weaker CPU of the same machine() and SIGILL.
+    if _cpu_flags():
+        cmd = ["g++", "-O3", "-march=native", "-ffp-contract=off",
+               "-funroll-loops", "-std=c++17",
+               "-shared", "-fPIC", "-pthread", *srcs, "-o", tmp]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True,
+                           timeout=120)
+            os.replace(tmp, out)
+            return out
+        except (OSError, subprocess.SubprocessError):
+            # -march=native can fail on exotic/emulated CPUs; go generic
+            pass
+    cmd = ["g++", "-O3", "-ffp-contract=off", "-std=c++17", "-shared",
+           "-fPIC", "-pthread", *srcs, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, out)

@@ -33,9 +33,10 @@ inline double seg_cost(const double* s1, const double* s2,
 
 // Scratch buffers reused across the rows a thread owns.
 struct Scratch {
-  std::vector<double> s1, s2, right, inv;
+  std::vector<double> s1, s2, left, right, inv, m;
   explicit Scratch(int64_t n)
-      : s1(n + 1), s2(n + 1), right(n + 1), inv(n + 1) {}
+      : s1(n + 1), s2(n + 1), left(n + 1), right(n + 1), inv(n + 1),
+        m(n + 1) {}
 };
 
 void row_bkps(const double* y, int64_t n, int32_t n_bkps, int32_t min_size,
@@ -68,75 +69,98 @@ void row_bkps(const double* y, int64_t n, int32_t n_bkps, int32_t min_size,
     return;
   }
 
-  // n_bkps == 2 — the O(n^2) sweep, restructured for SIMD: a value-only
-  // min pass (no index tracking, no division in the hot loop) followed
-  // by an O(n) index-recovery pass that recomputes the winning row with
-  // IDENTICAL operation order, so ties resolve exactly like the Python
-  // oracle's first-minimum argmin.
+  // n_bkps == 2 — the O(n^2) sweep, restructured gap-major for SIMD.
+  //
+  // The Python oracle (pipeline/segment.py:49-67) computes every cost as
+  // (s2[j]-s2[i]) - tot*tot/len with a true IEEE division; the fast pass
+  // here uses a reciprocal multiply instead (vdivpd would throttle the
+  // whole loop to division throughput).  That approximation is then made
+  // EXACT by a refinement pass: any `a` whose approximate minimum lies
+  // within a provable error bound of the approximate optimum is
+  // recomputed with true division, and the winner is selected with the
+  // oracle's tie semantics (first strict minimum over ascending a, then
+  // first strict minimum over ascending b).  For non-degenerate data the
+  // candidate set is a single `a`; fully-tied rows degrade to the exact
+  // scan but remain bit-faithful.
   out[0] = -1;
   out[1] = -1;
   if (n - 2 * min_size < min_size) return;
 
-  double* right = sc.right.data();  // cost(b, n), hoisted out of the a loop
-  double* inv = sc.inv.data();      // 1/len table: kills the per-pair fdiv
+  double* __restrict__ left = sc.left.data();    // cost(0, a), exact
+  double* __restrict__ right = sc.right.data();  // cost(b, n), exact
+  double* __restrict__ inv = sc.inv.data();      // 1/len reciprocals
+  double* __restrict__ m = sc.m.data();          // per-a approx min
   inv[0] = 0.0;
   for (int64_t len = 1; len <= n; ++len)
     inv[len] = 1.0 / static_cast<double>(len);
   for (int64_t b = min_size; b <= n - min_size; ++b) {
     const double tot = s1[n] - s1[b];
-    right[b] = (s2[n] - s2[b]) - tot * tot * inv[n - b];
+    right[b] = (s2[n] - s2[b]) - tot * tot / static_cast<double>(n - b);
+  }
+  for (int64_t a = min_size; a <= n - 2 * min_size; ++a) {
+    left[a] = s2[a] - s1[a] * s1[a] / static_cast<double>(a);
+    m[a] = 1.0 / 0.0;
   }
 
+  // pass A: approximate per-a minima, gap-major (unit-stride FMA + min)
+  for (int64_t g = min_size; g <= n - 2 * min_size; ++g) {
+    const double inv_g = inv[g];
+    const double* __restrict__ s1g = s1 + g;  // s1g[a] == s1[a + g]
+    const double* __restrict__ s2g = s2 + g;
+    const double* __restrict__ rg = right + g;
+    const int64_t a_hi = n - min_size - g;
+    for (int64_t a = min_size; a <= a_hi; ++a) {
+      const double tot = s1g[a] - s1[a];
+      const double mid = (s2g[a] - s2[a]) - tot * tot * inv_g;
+      const double c = (left[a] + mid) + rg[a];
+      m[a] = c < m[a] ? c : m[a];
+    }
+  }
+
+  double vt = 1.0 / 0.0;  // approximate optimum
+  for (int64_t a = min_size; a <= n - 2 * min_size; ++a)
+    vt = m[a] < vt ? m[a] : vt;
+  if (!(vt < 1.0 / 0.0)) return;
+
+  // sound error bound: approx and exact costs differ only in the
+  // tot^2*inv vs tot^2/len term plus downstream rounding, all bounded by
+  // a few ulps of the largest intermediate magnitude
+  double s1_abs_max = 0.0;
+  for (int64_t k = 0; k <= n; ++k) {
+    const double v = s1[k] < 0 ? -s1[k] : s1[k];
+    s1_abs_max = v > s1_abs_max ? v : s1_abs_max;
+  }
+  const double mag = s2[n] + 4.0 * s1_abs_max * s1_abs_max
+                             / static_cast<double>(min_size) + 1.0;
+  const double eps_abs = 32.0 * 2.220446049250313e-16 * mag;
+
+  // refinement: exact-division rescan of every candidate a, oracle ties
   double best = 0.0;
   int64_t best_a = -1;
   for (int64_t a = min_size; a <= n - 2 * min_size; ++a) {
-    const double tot_l = s1[a];
-    const double left = s2[a] - tot_l * tot_l * inv[a];
+    // 2x: |m~[a_v] - v*| <= eps and |v* - vt| <= eps can stack
+    if (m[a] > vt + 2.0 * eps_abs) continue;
+    const double lft = left[a];
     const double s1a = s1[a], s2a = s2[a];
-    const double* invs = inv - a;  // invs[b] == inv[b - a]
-    double m = 1.0 / 0.0;
+    double row_min = 1.0 / 0.0;
+    int64_t row_b = -1;
     for (int64_t b = a + min_size; b <= n - min_size; ++b) {
       const double tot = s1[b] - s1a;
-      const double mid = (s2[b] - s2a) - tot * tot * invs[b];
-      // same association as the oracle: (left + mid) + right
-      const double c = (left + mid) + right[b];
-      m = c < m ? c : m;
-    }
-    if (best_a < 0 || m < best) {
-      best = m;
-      best_a = a;
-    }
-  }
-  if (best_a < 0) return;
-
-  // recover the first b achieving the winning cost (exact recomputation)
-  {
-    const int64_t a = best_a;
-    const double tot_l = s1[a];
-    const double left = s2[a] - tot_l * tot_l * inv[a];
-    const double s1a = s1[a], s2a = s2[a];
-    const double* invs = inv - a;
-    for (int64_t b = a + min_size; b <= n - min_size; ++b) {
-      const double tot = s1[b] - s1a;
-      const double mid = (s2[b] - s2a) - tot * tot * invs[b];
-      const double c = (left + mid) + right[b];
-      if (c == best) {
-        out[0] = a;
-        out[1] = b;
-        return;
-      }
-    }
-    // floating quirk fallback (should be unreachable): rescan tracking min
-    double bb = 1.0 / 0.0;
-    for (int64_t b = a + min_size; b <= n - min_size; ++b) {
-      const double tot = s1[b] - s1a;
-      const double c = (left + ((s2[b] - s2a) - tot * tot * invs[b]))
+      // true division: IEEE-rounds identically to the NumPy oracle, so
+      // exact cost TIES break the same way
+      const double c = (lft + ((s2[b] - s2a)
+                                - tot * tot / static_cast<double>(b - a)))
                        + right[b];
-      if (c < bb) {
-        bb = c;
-        out[0] = a;
-        out[1] = b;
+      if (c < row_min) {
+        row_min = c;
+        row_b = b;
       }
+    }
+    if (row_b >= 0 && (best_a < 0 || row_min < best)) {
+      best = row_min;
+      best_a = a;
+      out[0] = a;
+      out[1] = row_b;
     }
   }
 }
