@@ -338,13 +338,7 @@ def _enum_bin_loglik(spec, reads, u, omega, log_pi, phi, lamb, log_lamb,
     keeps the input sharding.
     """
     if spec.enum_impl in ("pallas", "pallas_interpret"):
-        if not spec.fixed_lamb:
-            # the kernel's custom VJP emits no lamb cotangent: only valid
-            # when lambda is fixed (it is, in every enumerated step —
-            # pert_model.py:801)
-            raise ValueError(
-                "enum_impl='pallas' requires fixed_lamb=True: the fused "
-                "kernel does not differentiate through lambda")
+        _require_fixed_lamb(spec)
         from scdna_replication_tools_tpu.ops.enum_kernel import enum_loglik
         mu = u[:, None] * omega
         interpret = spec.enum_impl == "pallas_interpret"
@@ -370,6 +364,47 @@ def _enum_bin_loglik(spec, reads, u, omega, log_pi, phi, lamb, log_lamb,
     joint = _joint_logits(spec.P, reads, u, omega, log_pi, phi, lamb,
                           log_lamb, log1m_lamb)
     return logsumexp(joint, axis=(-2, -1))
+
+
+def _require_fixed_lamb(spec):
+    if not spec.fixed_lamb:
+        # the kernels' custom VJPs emit no lamb cotangent: only valid
+        # when lambda is fixed (it is, in every enumerated step —
+        # pert_model.py:801)
+        raise ValueError(
+            "enum_impl='pallas' requires fixed_lamb=True: the fused "
+            "kernel does not differentiate through lambda")
+
+
+def _enum_bin_loglik_fused(spec, reads, u, omega, pi_logits, phi, etas,
+                           lamb, mesh=None):
+    """(cells, loci) fused objective: enumerated bin log-likelihood PLUS
+    the Dirichlet data term sum_s (etas_s - 1) * log_softmax(pi)_s.
+
+    The Pallas kernel normalises pi_logits per-tile in VMEM, so the
+    (cells, loci, P) log_pi tensor and its softmax-Jacobian backward pass
+    never touch HBM — the dominant per-iteration traffic of the step-2
+    objective at genome scale (see ops/enum_kernel.py).
+    """
+    _require_fixed_lamb(spec)
+    from scdna_replication_tools_tpu.ops.enum_kernel import enum_loglik_fused
+    mu = u[:, None] * omega
+    interpret = spec.enum_impl == "pallas_interpret"
+    if mesh is None:
+        return enum_loglik_fused(reads, mu, pi_logits, phi, etas, lamb,
+                                 interpret)
+    from jax.sharding import PartitionSpec as PS
+    cells = mesh.axis_names[0]
+    lx = mesh.axis_names[1] if len(mesh.axis_names) > 1 else None
+    fn = jax.shard_map(
+        functools.partial(enum_loglik_fused, interpret=interpret),
+        mesh=mesh,
+        in_specs=(PS(cells, lx), PS(cells, lx), PS(cells, lx, None),
+                  PS(cells, lx), PS(cells, lx, None), PS()),
+        out_specs=PS(cells, lx),
+        check_vma=False,
+    )
+    return fn(reads, mu, pi_logits, phi, etas, lamb)
 
 
 def _observed_bin_loglik(spec, reads, u, omega, log_pi, phi, cn_obs, rep_obs,
@@ -404,28 +439,49 @@ def log_joint(spec: PertModelSpec, params: dict, fixed: dict,
     # never returns -inf, unlike log(softmax)
     etas = batch.etas if batch.etas is not None else \
         jnp.ones((num_cells, num_loci, spec.P), jnp.float32)
-    log_pi = c["log_pi"]
-    lp_pi = (
-        jnp.sum((etas - 1.0) * log_pi, axis=-1)
-        + gammaln(jnp.sum(etas, axis=-1))
-        - jnp.sum(gammaln(etas), axis=-1)
-    )
+    # fused path: the enumerated steps on the Pallas kernel fold both the
+    # log_softmax normalisation and the Dirichlet data term
+    # sum_s (etas_s - 1) * log_pi_s into the kernel, so log_pi is never
+    # materialised in HBM during training; only the parameter-free
+    # Dirichlet normaliser stays here (loop-invariant — XLA hoists it out
+    # of the compiled while-loop)
+    fused = (not spec.step1) and spec.enum_impl in ("pallas",
+                                                    "pallas_interpret")
+    if fused:
+        lp_pi = gammaln(jnp.sum(etas, axis=-1)) \
+            - jnp.sum(gammaln(etas), axis=-1)
+        pi_like = params["pi_logits"]
+    else:
+        log_pi = c["log_pi"]
+        # parenthesisation matters: the two gammaln terms are ~1.3e7 at
+        # the default 1e6 concentrations and cancel to ~1e2 — adding the
+        # small data term BEFORE the cancellation would absorb it into
+        # f32 rounding (spacing is 1.0 at that magnitude, ~1 per bin)
+        lp_pi = (
+            jnp.sum((etas - 1.0) * log_pi, axis=-1)
+            + (gammaln(jnp.sum(etas, axis=-1))
+               - jnp.sum(gammaln(etas), axis=-1))
+        )
+        pi_like = log_pi
     lp += jnp.sum(lp_pi * mask[:, None] * lmask[None, :])
 
     phi = _phi(c, num_loci)
     omega = gc_rate(c["betas"], batch.gamma_feats)               # :632-633
 
-    def bin_ll(reads, u, omega_, log_pi_, phi_, cn_obs, rep_obs):
+    def bin_ll(reads, u, omega_, pi_, phi_, cn_obs, rep_obs, etas_):
         if spec.step1:
-            return _observed_bin_loglik(spec, reads, u, omega_, log_pi_, phi_,
+            return _observed_bin_loglik(spec, reads, u, omega_, pi_, phi_,
                                         cn_obs, rep_obs, lamb, log_lamb,
                                         log1m_lamb)
-        return _enum_bin_loglik(spec, reads, u, omega_, log_pi_, phi_, lamb,
+        if fused:
+            return _enum_bin_loglik_fused(spec, reads, u, omega_, pi_, phi_,
+                                          etas_, lamb, mesh=mesh)
+        return _enum_bin_loglik(spec, reads, u, omega_, pi_, phi_, lamb,
                                 log_lamb, log1m_lamb, mesh=mesh)
 
     if spec.cell_chunk is None:
-        ll = bin_ll(batch.reads, c["u"], omega, log_pi, phi,
-                    batch.cn_obs, batch.rep_obs)
+        ll = bin_ll(batch.reads, c["u"], omega, pi_like, phi,
+                    batch.cn_obs, batch.rep_obs, etas if fused else None)
         lp += jnp.sum(ll * mask[:, None] * lmask[None, :])
     else:
         # chunk the cells axis through lax.map so only a
@@ -438,13 +494,14 @@ def log_joint(spec: PertModelSpec, params: dict, fixed: dict,
         def _r(x):
             return None if x is None else x.reshape((nch, ch) + x.shape[1:])
 
-        chunks = (_r(batch.reads), _r(c["u"]), _r(omega), _r(log_pi), _r(phi),
-                  _r(batch.cn_obs), _r(batch.rep_obs), _r(mask))
+        chunks = (_r(batch.reads), _r(c["u"]), _r(omega), _r(pi_like),
+                  _r(phi), _r(batch.cn_obs), _r(batch.rep_obs), _r(mask),
+                  _r(etas if fused else None))
 
         def body(args):
-            reads, u, omega_, log_pi_, phi_, cn_obs, rep_obs, m = args
-            return jnp.sum(bin_ll(reads, u, omega_, log_pi_, phi_, cn_obs,
-                                  rep_obs) * m[:, None] * lmask[None, :])
+            reads, u, omega_, pi_, phi_, cn_obs, rep_obs, m, etas_ = args
+            return jnp.sum(bin_ll(reads, u, omega_, pi_, phi_, cn_obs,
+                                  rep_obs, etas_) * m[:, None] * lmask[None, :])
 
         present = [x for x in chunks if x is not None]
         idxs = [i for i, x in enumerate(chunks) if x is not None]

@@ -295,3 +295,208 @@ def _enum_bwd(interpret, res, g):
 
 enum_loglik.defvjp(lambda r, m, lp, p, la, i: _enum_fwd(r, m, lp, p, la, i),
                    _enum_bwd)
+
+
+# ---------------------------------------------------------------------------
+# fused variant: log_softmax + Dirichlet data term inside the kernel
+# ---------------------------------------------------------------------------
+#
+# The training loop never needs log_pi = log_softmax(pi_logits) as a
+# tensor: it is consumed only by (a) the enumerated likelihood and (b) the
+# Dirichlet prior's data term sum_s (etas_s - 1) * log_pi_s
+# (reference: pert_model.py:608-611).  Materialising it costs a full
+# (cells, loci, P) HBM round-trip in the forward pass and a second one for
+# the softmax Jacobian in the backward pass — at 1000 x 5451 x 13 that is
+# ~1.7 GB of pure traffic per SVI iteration.  The fused kernels below read
+# pi_logits (and etas) once, normalise per-tile in VMEM, and emit the
+# combined per-bin objective and d/d pi_logits directly.
+#
+# The kernel returns  ll[c,l] + sum_s (etas[c,l,s]-1) * log_pi[c,l,s];
+# the etas-only Dirichlet normaliser (gammaln terms) is parameter-free and
+# stays outside (XLA hoists it out of the training while-loop).
+
+
+def _logZ(pi_ref, P, like):
+    """Per-bin log-normaliser of pi_logits over the P state slices."""
+    m = jnp.full_like(like, -jnp.inf)
+    z = jnp.zeros_like(like)
+
+    def body(s, carry):
+        m, z = carry
+        x = pi_ref[s]
+        m_new = jnp.maximum(m, x)
+        z = z * jnp.exp(m - m_new) + jnp.exp(x - m_new)
+        return m_new, z
+
+    m, z = jax.lax.fori_loop(0, P, body, (m, z))
+    return m + jnp.log(z)
+
+
+def _fused_fwd_kernel(scal_ref, reads_ref, mu_ref, phi_ref, pi_ref, etas_ref,
+                      out_ref, lse_ref, *, P):
+    log_lamb = scal_ref[0, 0]
+    log1m_lamb = scal_ref[0, 1]
+    q = scal_ref[0, 2]
+
+    x = reads_ref[...]
+    mu = mu_ref[...]
+    phi = phi_ref[...]
+    bern0 = jnp.log1p(-phi)
+    bern1 = jnp.log(phi)
+    logZ = _logZ(pi_ref, P, x)
+
+    neg_inf = jnp.full_like(x, -jnp.inf)
+
+    def body(s, carry):
+        m, acc, lp_acc = carry
+        lp = pi_ref[s] - logZ
+        lp_acc = lp_acc + (etas_ref[s] - 1.0) * lp
+        chi = s.astype(jnp.float32)
+        for bern, mult in ((bern0, 1.0), (bern1, 2.0)):
+            nb, _ = _nb_core(x, mu, chi * mult, q, log1m_lamb)
+            j = lp + bern + nb
+            m_new = jnp.maximum(m, j)
+            acc = acc * jnp.exp(m - m_new) + jnp.exp(j - m_new)
+            m = m_new
+        return m, acc, lp_acc
+
+    m, acc, lp_acc = jax.lax.fori_loop(
+        0, P, body, (neg_inf, jnp.zeros_like(x), jnp.zeros_like(x)))
+    lse = m + jnp.log(acc)
+    lse_ref[...] = lse
+    out_ref[...] = (lse + x * log_lamb - _lgamma_ge1(x + 1.0) + lp_acc)
+
+
+def _fused_bwd_kernel(scal_ref, reads_ref, mu_ref, phi_ref, pi_ref, etas_ref,
+                      lse_ref, g_ref, dmu_ref, dphi_ref, dpi_ref, *, P):
+    log1m_lamb = scal_ref[0, 1]
+    q = scal_ref[0, 2]
+
+    x = reads_ref[...]
+    mu = mu_ref[...]
+    phi = phi_ref[...]
+    g = g_ref[...]
+    lse = lse_ref[...]  # enumeration-only logsumexp saved by the fwd pass
+    bern0 = jnp.log1p(-phi)
+    bern1 = jnp.log(phi)
+    inv_phi = 1.0 / phi
+    inv_1m_phi = 1.0 / (1.0 - phi)
+    logZ = _logZ(pi_ref, P, x)
+
+    def body(s, carry):
+        dmu, dphi, tot = carry
+        lp = pi_ref[s] - logZ
+        chi = s.astype(jnp.float32)
+        # dL/dlog_pi_s: posterior weight of state s plus the Dirichlet term
+        dlp = g * (etas_ref[s] - 1.0)
+        for bern, dbern, mult in ((bern0, -inv_1m_phi, 1.0),
+                                  (bern1, inv_phi, 2.0)):
+            chi_r = chi * mult
+            nb, delta = _nb_core(x, mu, chi_r, q, log1m_lamb)
+            w = jnp.exp(lp + bern + nb - lse)
+            gw = g * w
+            ddelta = (_digamma_ge1(x + delta) - _digamma_ge1(delta)
+                      + log1m_lamb)
+            active = (mu * (chi_r * q) > 1.0).astype(jnp.float32)
+            dmu = dmu + gw * ddelta * active * (chi_r * q)
+            dphi = dphi + gw * dbern
+            dlp = dlp + gw
+        dpi_ref[s] = dlp
+        return dmu, dphi, tot + dlp
+
+    dmu, dphi, tot = jax.lax.fori_loop(
+        0, P, body,
+        (jnp.zeros_like(x), jnp.zeros_like(x), jnp.zeros_like(x)))
+    dmu_ref[...] = dmu
+    dphi_ref[...] = dphi
+
+    # softmax Jacobian: dpi_s = dlog_pi_s - softmax_s * sum_s' dlog_pi_s'
+    def fix(s, _):
+        dpi_ref[s] = dpi_ref[s] - jnp.exp(pi_ref[s] - logZ) * tot
+        return 0
+
+    jax.lax.fori_loop(0, P, fix, 0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
+def enum_loglik_fused(reads, mu, pi_logits, phi, etas, lamb, interpret=False):
+    """(cells, loci) fused objective:
+
+        logsumexp_{s,r} joint(s, r) + sum_s (etas_s - 1) * log_softmax(pi)_s
+
+    ``pi_logits``/``etas`` are (cells, loci, P).  Gradient contract: VJP
+    returns cotangents for ``mu``, ``pi_logits`` and ``phi``; ``reads``,
+    ``etas`` and ``lamb`` get silent zeros (observed data / fixed prior).
+    """
+    out, _ = _fused_fwd(reads, mu, pi_logits, phi, etas, lamb, interpret)
+    return out
+
+
+def _prep_fused(reads, mu, pi_logits, phi, etas, lamb):
+    scal = _scalars(lamb)
+    pi_t = jnp.transpose(pi_logits, (2, 0, 1))
+    etas_t = jnp.transpose(etas, (2, 0, 1))
+    return (scal,
+            _pad2(reads, TILE_C, TILE_L, 0.0),
+            _pad2(mu, TILE_C, TILE_L, 1.0),
+            _pad2(phi, TILE_C, TILE_L, 0.5),
+            _pad2(pi_t, TILE_C, TILE_L, 0.0),
+            _pad2(etas_t, TILE_C, TILE_L, 1.0))
+
+
+def _fused_fwd(reads, mu, pi_logits, phi, etas, lamb, interpret):
+    C, L = reads.shape
+    P = pi_logits.shape[-1]
+    scal, reads_p, mu_p, phi_p, pi_p, etas_p = _prep_fused(
+        reads, mu, pi_logits, phi, etas, lamb)
+    nc, nl = reads_p.shape
+
+    lay, grid = _grid_specs(P, nc, nl)
+    out, lse = pl.pallas_call(
+        functools.partial(_fused_fwd_kernel, P=P),
+        grid=grid,
+        in_specs=[lay["scal"], lay["cl"], lay["cl"], lay["cl"], lay["pcl"],
+                  lay["pcl"]],
+        out_specs=[lay["cl"], lay["cl"]],
+        out_shape=[jax.ShapeDtypeStruct((nc, nl), jnp.float32),
+                   jax.ShapeDtypeStruct((nc, nl), jnp.float32)],
+        interpret=interpret,
+    )(scal, reads_p, mu_p, phi_p, pi_p, etas_p)
+    return out[:C, :L], (reads, mu, pi_logits, phi, etas, lamb, lse[:C, :L])
+
+
+def _fused_bwd(interpret, res, g):
+    reads, mu, pi_logits, phi, etas, lamb, lse = res
+    C, L = reads.shape
+    P = pi_logits.shape[-1]
+    scal, reads_p, mu_p, phi_p, pi_p, etas_p = _prep_fused(
+        reads, mu, pi_logits, phi, etas, lamb)
+    lse_p = _pad2(lse, TILE_C, TILE_L, 0.0)
+    g_p = _pad2(g, TILE_C, TILE_L, 0.0)
+    nc, nl = reads_p.shape
+
+    lay, grid = _grid_specs(P, nc, nl)
+    dmu, dphi, dpi_t = pl.pallas_call(
+        functools.partial(_fused_bwd_kernel, P=P),
+        grid=grid,
+        in_specs=[lay["scal"], lay["cl"], lay["cl"], lay["cl"], lay["pcl"],
+                  lay["pcl"], lay["cl"], lay["cl"]],
+        out_specs=[lay["cl"], lay["cl"], lay["pcl"]],
+        out_shape=[
+            jax.ShapeDtypeStruct((nc, nl), jnp.float32),
+            jax.ShapeDtypeStruct((nc, nl), jnp.float32),
+            jax.ShapeDtypeStruct((P, nc, nl), jnp.float32),
+        ],
+        interpret=interpret,
+    )(scal, reads_p, mu_p, phi_p, pi_p, etas_p, lse_p, g_p)
+
+    dmu = dmu[:C, :L]
+    dphi = dphi[:C, :L]
+    dpi = jnp.transpose(dpi_t[:, :C, :L], (1, 2, 0))
+    return (jnp.zeros_like(reads), dmu, dpi, dphi,
+            jnp.zeros_like(etas), jnp.zeros_like(jnp.asarray(lamb)))
+
+
+enum_loglik_fused.defvjp(
+    lambda r, m, pi, p, e, la, i: _fused_fwd(r, m, pi, p, e, la, i),
+    _fused_bwd)
