@@ -10,6 +10,7 @@ cached as "unavailable" and callers fall back to NumPy.
 from __future__ import annotations
 
 import ctypes
+import functools
 import logging
 import os
 import subprocess
@@ -31,6 +32,7 @@ def _build_dir() -> str:
 _SOURCES = ("pivot.cpp", "segment.cpp")
 
 
+@functools.lru_cache(maxsize=1)
 def _so_path() -> str:
     tag = sysconfig.get_config_var("SOABI") or "generic"
     # -march=native binaries must never be reused on a different CPU
@@ -47,6 +49,7 @@ def _so_path() -> str:
     return os.path.join(_build_dir(), f"native.{tag}.{isa}.so")
 
 
+@functools.lru_cache(maxsize=1)
 def _cpu_flags() -> str:
     """The CPU feature list, or '' when no source exists (non-Linux)."""
     try:
@@ -88,7 +91,10 @@ def _compile() -> Optional[str]:
             return out
         except (OSError, subprocess.SubprocessError):
             # -march=native can fail on exotic/emulated CPUs; go generic
-            pass
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
     cmd = ["g++", "-O3", "-ffp-contract=off", "-std=c++17", "-shared",
            "-fPIC", "-pthread", *srcs, "-o", tmp]
     try:

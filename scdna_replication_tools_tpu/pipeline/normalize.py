@@ -367,10 +367,12 @@ def normalize_by_cell(cn_s: pd.DataFrame, cn_g1: pd.DataFrame,
 
     # engine == 'batch': one genome-order permutation of the shared pivot
     # columns, one padded (cells, loci) matrix, one batched CNA pass.
-    from scdna_replication_tools_tpu.utils.chrom import CHR_ORDER
+    from scdna_replication_tools_tpu.utils.chrom import (
+        CHR_ORDER,
+        as_chr_categorical_array,
+    )
 
-    cat = pd.Categorical(np.asarray(chr_vals), categories=CHR_ORDER,
-                         ordered=True)
+    cat = as_chr_categorical_array(chr_vals)
     codes = cat.codes.astype(np.int64)
     codes = np.where(codes < 0, len(CHR_ORDER), codes)  # unknown chr last
     perm = np.lexsort((np.asarray(start_vals), codes))
@@ -410,8 +412,7 @@ def normalize_by_cell(cn_s: pd.DataFrame, cn_g1: pd.DataFrame,
     rt, chng = remove_cell_specific_CNAs_batch(Y, row_len, chrom_rows)
 
     out = pd.DataFrame({
-        chr_col: pd.Categorical(np.concatenate(chrom_rows),
-                                categories=CHR_ORDER, ordered=True),
+        chr_col: as_chr_categorical_array(np.concatenate(chrom_rows)),
         start_col: np.concatenate(start_rows),
         cell_col: np.repeat(s_mat.index.to_numpy(), row_len),
         temp_col: np.concatenate(temp_rows),

@@ -7,6 +7,7 @@ chromosomes 1..22 then X then Y, sorted within cell by (chr, start).
 
 from __future__ import annotations
 
+import numpy as np
 import pandas as pd
 
 CHR_ORDER = [str(i + 1) for i in range(22)] + ["X", "Y"]
@@ -16,6 +17,17 @@ def as_chr_categorical(series: pd.Series) -> pd.Series:
     """Cast a chromosome column to the canonical ordered categorical."""
     s = series.astype(str).astype("category")
     return s.cat.set_categories(CHR_ORDER, ordered=True)
+
+
+def as_chr_categorical_array(values) -> pd.Categorical:
+    """Array-level twin of :func:`as_chr_categorical`.
+
+    Infer-then-``set_categories`` coerces non-canonical contigs to NaN;
+    passing them to the ``pd.Categorical(values, categories=...)``
+    constructor is deprecated and will raise in a future pandas.
+    """
+    cat = pd.Categorical(np.asarray(values).astype(str))
+    return cat.set_categories(CHR_ORDER, ordered=True)
 
 
 def sort_by_cell_and_loci(
