@@ -51,7 +51,8 @@ class scRT:
                  cuda=False, seed=0, P=13, K=4, J=5, upsilon=6,
                  run_step3=True, backend='jax', num_shards=1,
                  loci_shards=1, cell_chunk=None, checkpoint_dir=None,
-                 enum_impl='auto', cn_hmm_self_prob=None):
+                 enum_impl='auto', cn_hmm_self_prob=None,
+                 rho_from_rt_prior=False):
         self.cn_s = cn_s
         self.cn_g1 = cn_g1
         self.clone_col = clone_col
@@ -76,6 +77,7 @@ class scRT:
             cell_chunk=cell_chunk,
             checkpoint_dir=checkpoint_dir, enum_impl=enum_impl,
             cn_hmm_self_prob=cn_hmm_self_prob,
+            rho_from_rt_prior=rho_from_rt_prior,
         )
 
         self.clone_profiles = None

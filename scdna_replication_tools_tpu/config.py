@@ -62,6 +62,15 @@ class PertConfig:
     # --- priors / conditioning ---
     cn_prior_method: str = "g1_composite"
     cn_prior_weight: float = 1e6
+    # condition the per-locus replication-timing profile rho on the
+    # RT-prior column (rt_prior_col, rescaled to [0, 1]) instead of
+    # learning it.  The reference LOADS the prior
+    # (pert_model.py:182-187) and defines the conditioning branch
+    # (model_s's rho0, pert_model.py:568-570) but never connects the two
+    # — rho0 is dead code in run_pert_model.  Default False preserves
+    # that behaviour (rho learned, prior ignored); True wires the
+    # capability the reference left unfinished.
+    rho_from_rt_prior: bool = False
 
     # --- optimisation (reference: pert_model.py:41, 104-120, 734) ---
     learning_rate: float = 0.05

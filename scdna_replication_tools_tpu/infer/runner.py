@@ -126,6 +126,13 @@ class PertInference:
         self.clone_idx_g1 = clone_idx_g1
         self.num_clones = num_clones
         self.L = s_data.num_libraries
+        if config.rho_from_rt_prior and s_data.rt_prior is None:
+            # fail fast: surfacing this inside run_step2 would waste the
+            # whole step-1 fit first
+            raise ValueError(
+                "rho_from_rt_prior=True but no RT-prior column was found "
+                "in the input (rt_prior_col); provide the column or drop "
+                "the flag")
         self._mesh = None
         ls = config.loci_shards
         if config.num_shards is None or config.num_shards == 0:
@@ -328,6 +335,7 @@ class PertInference:
             "beta_means": c1["beta_means"],   # pert_model.py:782-787
             "lamb": c1["lamb"],               # pert_model.py:801 (lamb=...)
         }
+        cond_rho = bool(self.config.rho_from_rt_prior)
         # initial S-phase times from the real (unpadded) cells/loci only
         t_init_real, _, _ = guess_times(jnp.asarray(self.s.reads),
                                         jnp.asarray(etas),
@@ -338,6 +346,16 @@ class PertInference:
         t_init = np.pad(np.asarray(t_init_real),
                         (0, s.num_cells - self.s.num_cells),
                         constant_values=0.4)
+        if cond_rho:
+            # the conditioning branch the reference defined but never
+            # exercised (model_s's rho0, pert_model.py:568-570); rho has
+            # no prior term either way (Beta(1,1) logpdf = 0).  The loader
+            # only divides by the max (reference: pert_model.py:254-257),
+            # so a prior column with negative values (repli-seq log-ratios)
+            # would leave rho outside [0, 1] — clamp to the learned path's
+            # domain.
+            fixed["rho"] = jnp.clip(
+                jnp.asarray(s.rt_prior, jnp.float32), 0.0, 1.0)
         batch = PertBatch(
             reads=jnp.asarray(s.reads),
             libs=jnp.asarray(s.libs),
@@ -349,6 +367,7 @@ class PertInference:
         spec = PertModelSpec(
             P=self.config.P, K=self.config.K, L=self.L,
             tau_mode="param", step1=False, cond_beta_means=True,
+            cond_rho=cond_rho,
             fixed_lamb=True, cell_chunk=self.config.cell_chunk,
             enum_impl=self._enum_impl())
         out = self._fit(spec, batch, fixed, t_init,

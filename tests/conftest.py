@@ -77,21 +77,21 @@ def synthetic_frames():
     return df_s, df_g
 
 
-def dense_inputs_from_frames(synthetic_frames):
+def dense_inputs_from_frames(synthetic_frames, rt_prior_col=None):
     """Dense PertData inputs + clone indices from the synthetic frames.
 
-    Shared by the padding/sharding and checkpoint test modules.
+    Shared by the padding/sharding, checkpoint and rho-prior test modules.
     """
     from scdna_replication_tools_tpu.config import ColumnConfig
     from scdna_replication_tools_tpu.data.loader import build_pert_inputs
 
-    df_s, df_g = synthetic_frames
+    df_s, df_g = (df.copy() for df in synthetic_frames)
     rng = np.random.default_rng(0)
     for df in (df_s, df_g):
         df["reads"] = rng.poisson(
             40 * df["true_somatic_cn"].to_numpy()).astype(float)
         df["state"] = df["true_somatic_cn"].astype(int)
-    cols = ColumnConfig(rt_prior_col=None)
+    cols = ColumnConfig(rt_prior_col=rt_prior_col)
     s, g1 = build_pert_inputs(df_s, df_g, cols)
     clone_idx = np.array([0] * 12 + [1] * 12, np.int32)
     return s, g1, clone_idx

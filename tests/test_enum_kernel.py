@@ -24,6 +24,7 @@ from scdna_replication_tools_tpu.ops.enum_kernel import (
     _digamma_ge1,
     _lgamma_ge1,
     enum_loglik,
+    enum_loglik_fused,
 )
 from scdna_replication_tools_tpu.ops.gc import gc_features
 
@@ -89,6 +90,60 @@ def test_gradient_parity_with_xla_oracle():
     for a, b in zip(g_ref, g_pal):
         rel = jnp.max(jnp.abs(a - b)) / (jnp.max(jnp.abs(a)) + 1e-30)
         assert float(rel) < 2e-2, float(rel)
+
+
+def _fused_xla_oracle(reads, mu, pi_logits, phi, etas, lamb):
+    """XLA transcription of the fused objective: enumerated likelihood
+    plus the Dirichlet data term sum_s (etas_s - 1) * log_softmax(pi)_s."""
+    log_pi = jax.nn.log_softmax(pi_logits, -1)
+    return _xla_oracle(reads, mu, log_pi, phi, lamb) \
+        + jnp.sum((etas - 1.0) * log_pi, axis=-1)
+
+
+@pytest.mark.parametrize("etas_kind", ["random_small", "concentrated_1e6"])
+def test_fused_gradient_parity_with_xla_oracle(etas_kind):
+    """Direct gradient test of enum_loglik_fused with RANDOM etas and
+    random cotangents.
+
+    The fused backward applies the softmax Jacobian itself — dpi_s =
+    dlog_pi_s - softmax_s * tot, where tot accumulates BOTH the posterior
+    weights and the g*(etas-1) Dirichlet term (ops/enum_kernel.py, the
+    `tot` carry of _fused_bwd_kernel).  The near-one-hot etas of the full
+    -loss parity tests barely exercise that correction; random etas and
+    cotangents pin it against jax.grad through the XLA oracle.
+    """
+    reads, mu, logits, phi, lamb = _problem(C=8, L=96, seed=7)
+    rng = np.random.default_rng(11)
+    if etas_kind == "random_small":
+        etas = jnp.asarray(rng.uniform(0.3, 5.0, logits.shape)
+                           .astype(np.float32))
+    else:
+        # the production regime: one state per bin carries the 1e6
+        # prior concentration (cn_prior_weight), the rest stay at 1
+        etas_np = np.ones(logits.shape, np.float32)
+        states = rng.integers(0, P, reads.shape)
+        np.put_along_axis(etas_np, states[..., None], 1e6, axis=-1)
+        etas = jnp.asarray(etas_np)
+    w = jnp.asarray(rng.normal(0, 1, reads.shape), jnp.float32)
+
+    def loss(fn, mu, logits, phi):
+        return jnp.sum(fn(reads, mu, logits, phi, etas, lamb) * w)
+
+    g_ref = jax.grad(lambda *a: loss(_fused_xla_oracle, *a), (0, 1, 2))(
+        mu, logits, phi)
+    g_pal = jax.grad(
+        lambda *a: loss(lambda *b: enum_loglik_fused(*b, True), *a),
+        (0, 1, 2))(mu, logits, phi)
+
+    out_ref = _fused_xla_oracle(reads, mu, logits, phi, etas, lamb)
+    out_pal = enum_loglik_fused(reads, mu, logits, phi, etas, lamb, True)
+    fwd_rel = jnp.max(jnp.abs(out_ref - out_pal)) \
+        / (jnp.max(jnp.abs(out_ref)) + 1e-30)
+    assert float(fwd_rel) < 1e-4, float(fwd_rel)
+
+    for name, a, b in zip(("dmu", "dpi_logits", "dphi"), g_ref, g_pal):
+        rel = jnp.max(jnp.abs(a - b)) / (jnp.max(jnp.abs(a)) + 1e-30)
+        assert float(rel) < 2e-2, (name, float(rel))
 
 
 def test_pert_loss_parity_between_impls():

@@ -1,0 +1,63 @@
+"""rt_prior -> rho conditioning (PertConfig.rho_from_rt_prior).
+
+The reference loads and rescales the RT-prior column
+(reference: pert_model.py:182-187, 254-257) and defines a conditioning
+branch in the model (rho0, reference: pert_model.py:568-570), but never
+connects the two — run_pert_model never passes rho0.  Our opt-in flag
+wires that capability: step 2 fixes rho to the rescaled prior instead of
+learning it.  Default-off preserves reference behaviour.
+"""
+
+import numpy as np
+import pytest
+
+from conftest import dense_inputs_from_frames
+from scdna_replication_tools_tpu.config import PertConfig
+from scdna_replication_tools_tpu.infer.runner import PertInference
+from scdna_replication_tools_tpu.models.pert import constrained
+
+
+def _dense_inputs(synthetic_frames, rt_prior_col):
+    return dense_inputs_from_frames(synthetic_frames,
+                                    rt_prior_col=rt_prior_col)
+
+
+def _run_step2(s, g1, clone_idx, **cfg_kwargs):
+    cfg = PertConfig(max_iter=10, min_iter=2, max_iter_step1=6,
+                     min_iter_step1=2, run_step3=False,
+                     cn_prior_method="hmmcopy", enum_impl="xla",
+                     **cfg_kwargs)
+    inf = PertInference(s, g1, cfg, clone_idx_s=clone_idx,
+                        clone_idx_g1=clone_idx, num_clones=2)
+    step1 = inf.run_step1()
+    etas = inf.build_etas()
+    return inf.run_step2(step1, etas)
+
+
+def test_rho_conditioned_on_rt_prior(synthetic_frames):
+    s, g1, clone_idx = _dense_inputs(synthetic_frames, "mcf7rt")
+    assert s.rt_prior is not None
+    step2 = _run_step2(s, g1, clone_idx, rho_from_rt_prior=True)
+
+    # rho is fixed to the loader's rescaled prior, not learned
+    assert "rho_raw" not in step2.fit.params
+    c2 = constrained(step2.spec, step2.fit.params, step2.fixed)
+    np.testing.assert_allclose(np.asarray(c2["rho"]), s.rt_prior, atol=1e-6)
+    assert step2.fit.num_iters > 0
+    assert np.isfinite(step2.fit.losses).all()
+
+
+def test_rho_learned_by_default(synthetic_frames):
+    s, g1, clone_idx = _dense_inputs(synthetic_frames, "mcf7rt")
+    step2 = _run_step2(s, g1, clone_idx)
+    assert "rho_raw" in step2.fit.params
+    c2 = constrained(step2.spec, step2.fit.params, step2.fixed)
+    # the learned profile moves away from the prior (it is not conditioned)
+    assert not np.allclose(np.asarray(c2["rho"]), s.rt_prior, atol=1e-6)
+
+
+def test_missing_rt_prior_raises(synthetic_frames):
+    s, g1, clone_idx = _dense_inputs(synthetic_frames, None)
+    assert s.rt_prior is None
+    with pytest.raises(ValueError, match="rho_from_rt_prior"):
+        _run_step2(s, g1, clone_idx, rho_from_rt_prior=True)
