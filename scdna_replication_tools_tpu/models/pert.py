@@ -205,11 +205,19 @@ def init_params(spec: PertModelSpec, batch: PertBatch, fixed: dict,
     beta_means0 = fixed["beta_means"] if spec.cond_beta_means else params["beta_means"]
     params["betas"] = jnp.asarray(beta_means0)[batch.libs].astype(jnp.float32)
 
+    # pi_logits is stored STATE-MAJOR (P, cells, loci): the fused Pallas
+    # kernel consumes per-state (cells, loci) tiles, and a cells-major
+    # layout would cost a ~full-tensor transpose in BOTH passes of every
+    # SVI iteration (pi changes each step, so XLA cannot hoist it) plus a
+    # third for the returned gradient — at genome scale more HBM traffic
+    # than the kernel itself.
     if not spec.step1 and batch.etas is not None:
         pi0 = batch.etas / jnp.sum(batch.etas, axis=-1, keepdims=True)
-        params["pi_logits"] = jnp.log(jnp.clip(pi0, 1e-30, None))
+        params["pi_logits"] = jnp.transpose(
+            jnp.log(jnp.clip(pi0, 1e-30, None)), (2, 0, 1))
     else:
-        params["pi_logits"] = jnp.zeros((num_cells, num_loci, spec.P), jnp.float32)
+        params["pi_logits"] = jnp.zeros((spec.P, num_cells, num_loci),
+                                        jnp.float32)
 
     return params
 
@@ -249,8 +257,12 @@ def constrained(spec: PertModelSpec, params: dict, fixed: dict) -> dict:
     out["betas"] = params["betas"]
     # log-space simplex: log_softmax stays finite even when a disfavored
     # state's float32 probability underflows to 0 (log(softmax(x)) would
-    # give -inf and NaN gradients under the huge 1e6 prior concentrations)
-    out["log_pi"] = jax.nn.log_softmax(params["pi_logits"], axis=-1)
+    # give -inf and NaN gradients under the huge 1e6 prior concentrations).
+    # The parameter is state-major (P, cells, loci) — see init_params;
+    # out["log_pi"] keeps the (cells, loci, P) convention its consumers
+    # (decode, step-1 gather, XLA enum path) expect.
+    out["log_pi"] = jnp.transpose(
+        jax.nn.log_softmax(params["pi_logits"], axis=0), (1, 2, 0))
     out["pi"] = jnp.exp(out["log_pi"])
     return out
 

@@ -419,36 +419,44 @@ def _fused_bwd_kernel(scal_ref, reads_ref, mu_ref, phi_ref, pi_ref, etas_ref,
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
-def enum_loglik_fused(reads, mu, pi_logits, phi, etas, lamb, interpret=False):
+def enum_loglik_fused(reads, mu, pi_logits_t, phi, etas_t, lamb,
+                      interpret=False):
     """(cells, loci) fused objective:
 
         logsumexp_{s,r} joint(s, r) + sum_s (etas_s - 1) * log_softmax(pi)_s
 
-    ``pi_logits``/``etas`` are (cells, loci, P).  Gradient contract: VJP
-    returns cotangents for ``mu``, ``pi_logits`` and ``phi``; ``reads``,
-    ``etas`` and ``lamb`` get silent zeros (observed data / fixed prior).
+    ``pi_logits_t``/``etas_t`` are **(P, cells, loci)** — state-major, the
+    layout the kernel consumes directly.  This is deliberate: the pi
+    parameter is stored state-major throughout training (models/pert.py
+    ``init_params``) precisely so that NO per-iteration transpose of the
+    ~(cells x loci x P) tensor is needed in either pass — at genome scale
+    the (2 fwd + 1 dpi) transposes of a cells-major layout cost more HBM
+    traffic than the kernel itself.  Gradient contract: VJP returns
+    cotangents for ``mu``, ``pi_logits_t`` (state-major, matching the
+    parameter) and ``phi``; ``reads``, ``etas_t`` and ``lamb`` get silent
+    zeros (observed data / fixed prior).
     """
-    out, _ = _fused_fwd(reads, mu, pi_logits, phi, etas, lamb, interpret)
+    out, _ = _fused_fwd(reads, mu, pi_logits_t, phi, etas_t, lamb, interpret)
     return out
 
 
-def _prep_fused(reads, mu, pi_logits, phi, etas, lamb):
+def _prep_fused(reads, mu, pi_logits_t, phi, etas_t, lamb):
+    # inputs arrive state-major; _pad2 is a no-op when the runner has
+    # already padded cells/loci to tile multiples (pad_cells/pad_loci)
     scal = _scalars(lamb)
-    pi_t = jnp.transpose(pi_logits, (2, 0, 1))
-    etas_t = jnp.transpose(etas, (2, 0, 1))
     return (scal,
             _pad2(reads, TILE_C, TILE_L, 0.0),
             _pad2(mu, TILE_C, TILE_L, 1.0),
             _pad2(phi, TILE_C, TILE_L, 0.5),
-            _pad2(pi_t, TILE_C, TILE_L, 0.0),
+            _pad2(pi_logits_t, TILE_C, TILE_L, 0.0),
             _pad2(etas_t, TILE_C, TILE_L, 1.0))
 
 
-def _fused_fwd(reads, mu, pi_logits, phi, etas, lamb, interpret):
+def _fused_fwd(reads, mu, pi_logits_t, phi, etas_t, lamb, interpret):
     C, L = reads.shape
-    P = pi_logits.shape[-1]
+    P = pi_logits_t.shape[0]
     scal, reads_p, mu_p, phi_p, pi_p, etas_p = _prep_fused(
-        reads, mu, pi_logits, phi, etas, lamb)
+        reads, mu, pi_logits_t, phi, etas_t, lamb)
     nc, nl = reads_p.shape
 
     lay, grid = _grid_specs(P, nc, nl)
@@ -462,15 +470,16 @@ def _fused_fwd(reads, mu, pi_logits, phi, etas, lamb, interpret):
                    jax.ShapeDtypeStruct((nc, nl), jnp.float32)],
         interpret=interpret,
     )(scal, reads_p, mu_p, phi_p, pi_p, etas_p)
-    return out[:C, :L], (reads, mu, pi_logits, phi, etas, lamb, lse[:C, :L])
+    return out[:C, :L], (reads, mu, pi_logits_t, phi, etas_t, lamb,
+                         lse[:C, :L])
 
 
 def _fused_bwd(interpret, res, g):
-    reads, mu, pi_logits, phi, etas, lamb, lse = res
+    reads, mu, pi_logits_t, phi, etas_t, lamb, lse = res
     C, L = reads.shape
-    P = pi_logits.shape[-1]
+    P = pi_logits_t.shape[0]
     scal, reads_p, mu_p, phi_p, pi_p, etas_p = _prep_fused(
-        reads, mu, pi_logits, phi, etas, lamb)
+        reads, mu, pi_logits_t, phi, etas_t, lamb)
     lse_p = _pad2(lse, TILE_C, TILE_L, 0.0)
     g_p = _pad2(g, TILE_C, TILE_L, 0.0)
     nc, nl = reads_p.shape
@@ -492,9 +501,9 @@ def _fused_bwd(interpret, res, g):
 
     dmu = dmu[:C, :L]
     dphi = dphi[:C, :L]
-    dpi = jnp.transpose(dpi_t[:, :C, :L], (1, 2, 0))
-    return (jnp.zeros_like(reads), dmu, dpi, dphi,
-            jnp.zeros_like(etas), jnp.zeros_like(jnp.asarray(lamb)))
+    dpi_t = dpi_t[:, :C, :L]
+    return (jnp.zeros_like(reads), dmu, dpi_t, dphi,
+            jnp.zeros_like(etas_t), jnp.zeros_like(jnp.asarray(lamb)))
 
 
 enum_loglik_fused.defvjp(

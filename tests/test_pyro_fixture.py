@@ -1,0 +1,73 @@
+"""Consume the recorded Pyro head-to-head fixture when it exists.
+
+The build image cannot produce the recorded Pyro run itself: ``pyro-ppl``
+is not installed and the image has no network egress, so
+``tools/compare_vs_pyro.py`` (and the best-effort ``pyro-parity`` CI job
+that runs it and uploads ``pyro_compare.json``) must execute on a
+networked machine.  THIS module is the receiving end: the moment a
+``pyro_compare.json`` is checked in at the repo root or under ``tools/``,
+these assertions activate and pin the framework against the actual
+reference execution (reference: pert_model.py:792-830) —
+
+* matched final step-2 loss scale (the north star's matched-ELBO half,
+  BASELINE.json);
+* >= 95% cn/rep decode agreement;
+* tau correlation >= 0.95 between implementations;
+* our truth-accuracy within 5 points of Pyro's (the calibration the
+  e2e-test bars derive from).
+
+Until then the suite's anchor remains tests/test_reference_oracle.py's
+independent float64 transcription, and this module skips with an
+explanatory message rather than passing silently.
+"""
+
+import json
+import pathlib
+
+import pytest
+
+_CANDIDATES = [
+    pathlib.Path(__file__).resolve().parent.parent / "pyro_compare.json",
+    pathlib.Path(__file__).resolve().parent.parent / "tools"
+    / "pyro_compare.json",
+]
+
+
+@pytest.fixture(scope="module")
+def pyro_report():
+    for p in _CANDIDATES:
+        if p.exists():
+            with open(p) as fh:
+                return json.load(fh)
+    pytest.skip(
+        "no recorded pyro_compare.json fixture: pyro-ppl is not "
+        "installable in this image (no network egress) — produce it with "
+        "`python tools/compare_vs_pyro.py` on a networked machine or via "
+        "the pyro-parity CI job, then check the JSON in at the repo root")
+
+
+def test_matched_final_loss_scale(pyro_report):
+    jax_loss = pyro_report["jax_final_loss_s"]
+    ref_loss = pyro_report["pyro_final_loss_s"]
+    rel = abs(jax_loss - ref_loss) / max(abs(ref_loss), 1.0)
+    assert rel < 0.05, (
+        f"final step-2 loss mismatch: jax {jax_loss} vs pyro {ref_loss} "
+        f"(rel {rel:.3f})")
+
+
+def test_decode_agreement(pyro_report):
+    assert pyro_report["rep_agreement"] >= 0.95, pyro_report
+    assert pyro_report["cn_agreement"] >= 0.95, pyro_report
+
+
+def test_tau_correlation(pyro_report):
+    assert pyro_report["tau_correlation"] >= 0.95, pyro_report
+
+
+def test_truth_accuracy_not_worse_than_pyro(pyro_report):
+    """The e2e bars (test_end_to_end.py) calibrate from this: our
+    accuracy vs simulator truth must sit within 5 points of what the
+    Pyro reference achieves on the identical workload."""
+    ours = pyro_report["jax_rep_acc_vs_truth"]
+    theirs = pyro_report["pyro_rep_acc_vs_truth"]
+    assert ours >= theirs - 0.05, (ours, theirs)

@@ -1,0 +1,181 @@
+"""Full-pipeline genome-scale benchmark: ``scRT.infer('pert')`` wall-clock.
+
+``bench.py`` times only the steady-state step-2 SVI iteration; THIS tool
+measures the north-star metric of BASELINE.md configs 3-4 — the complete
+user-facing pipeline at genome scale (default 1,000 S + 250 G1 cells x
+~5.4k hg19 500kb bins from ``data/example_bins.py``), on the accelerator,
+INCLUDING compile time, prior construction, ``guess_times``, host pivots,
+all three SVI steps, decode and pandas packaging.  The reference's own
+scaling guidance for this regime: ``/root/reference/README.md:55-57``.
+
+Writes one JSON artifact (--out) with per-phase wall-clock, per-step
+iteration counts/losses, throughput, and (optionally, --profile-dir) a
+``jax.profiler`` trace of the step-2 fit for roofline analysis.
+
+Synthetic workload: 2 clones with multi-chromosome CNAs, NB reads drawn
+from the PERT generative model (GC bias + replication structure), so the
+run exercises realistic priors, masking and decode — not the flat etas of
+bench.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+import pandas as pd
+
+
+def make_genome_workload(num_s_cells, num_g1_cells, bin_size=500_000,
+                         seed=0):
+    """Long-form S/G1 frames over the genome-wide example bin table.
+
+    Reads are drawn directly from the PERT observation model (NumPy, fast
+    at 10k-cell scale): per-cell tau, per-bin replication state from the
+    RT profile, NB(delta, lamb) reads with a GC polynomial rate.
+    """
+    from scdna_replication_tools_tpu.data.example_bins import (
+        make_example_bins,
+    )
+
+    rng = np.random.default_rng(seed)
+    bins = make_example_bins(bin_size=bin_size, seed=seed)
+    num_loci = len(bins)
+    gc = bins["gc"].to_numpy()
+    rt = bins["mcf7rt"].to_numpy()
+
+    # two clones with CNAs on different chromosomes
+    cn_a = np.full(num_loci, 2.0)
+    cn_b = np.full(num_loci, 2.0)
+    chr_arr = bins["chr"].to_numpy()
+    c1 = np.flatnonzero(chr_arr == "1")
+    c2 = np.flatnonzero(chr_arr == "2")
+    c5 = np.flatnonzero(chr_arr == "5")
+    cn_a[c1[: len(c1) // 3]] = 3.0
+    cn_a[c5[: len(c5) // 4]] = 1.0
+    cn_b[c2[: len(c2) // 3]] = 4.0
+
+    lamb, a_true = 0.75, 10.0
+    gc_rate = np.exp(0.5 * gc)          # betas=[0.5, 0.0]
+
+    def draw(prefix, n, s_phase):
+        clones = np.where(rng.random(n) < 0.5, "A", "B")
+        cell_ids = [f"{prefix}_{clones[i]}_{i}" for i in range(n)]
+        cn = np.where((clones == "A")[:, None], cn_a[None, :], cn_b[None, :])
+        if s_phase:
+            tau = rng.uniform(0.05, 0.95, n)
+            phi = 1.0 / (1.0 + np.exp(-a_true * (tau[:, None] - (1.0 - rt)[None, :])))
+            rep = (rng.random((n, num_loci)) < phi).astype(np.float32)
+        else:
+            tau = np.zeros(n)
+            rep = np.zeros((n, num_loci), np.float32)
+        chi = cn * (1.0 + rep)
+        u = rng.uniform(8.0, 14.0, n)
+        theta = u[:, None] * chi * gc_rate[None, :]
+        delta = np.maximum(theta * (1 - lamb) / lamb, 1.0)
+        reads = rng.negative_binomial(delta, 1.0 - lamb).astype(np.float64)
+
+        frames = []
+        for i in range(n):
+            frames.append(pd.DataFrame({
+                "cell_id": cell_ids[i], "chr": chr_arr,
+                "start": bins["start"], "end": bins["end"], "gc": gc,
+                "mcf7rt": rt, "library_id": "LIB0", "clone_id": clones[i],
+                "reads": reads[i], "state": cn[i].astype(int),
+                "copy": cn[i],
+            }))
+        df = pd.concat(frames, ignore_index=True)
+        truth = pd.DataFrame({"cell_id": cell_ids, "true_t": tau})
+        return df, truth
+
+    df_s, truth_s = draw("s", num_s_cells, True)
+    df_g, _ = draw("g", num_g1_cells, False)
+    return df_s, df_g, truth_s
+
+
+def run(args):
+    import jax
+
+    from scdna_replication_tools_tpu.api import scRT
+
+    t0 = time.perf_counter()
+    df_s, df_g, truth_s = make_genome_workload(args.cells, args.g1_cells,
+                                               seed=args.seed)
+    t_data = time.perf_counter() - t0
+    num_loci = df_s.groupby(["chr", "start"]).ngroups
+
+    scrt = scRT(df_s, df_g, input_col="reads", clone_col="clone_id",
+                assign_col="copy", cn_prior_method=args.cn_prior_method,
+                max_iter=args.max_iter, min_iter=args.min_iter,
+                run_step3=args.run_step3, enum_impl=args.enum_impl)
+    if args.profile_dir:
+        import dataclasses
+        scrt.config = dataclasses.replace(scrt.config,
+                                          profile_dir=args.profile_dir)
+
+    t1 = time.perf_counter()
+    cn_s_out, supp_s, cn_g1_out, supp_g1 = scrt.infer(level="pert")
+    t_infer = time.perf_counter() - t1
+
+    # per-step evidence from the supplementary table + runner step walls
+    loss_s = supp_s.query("param == 'loss_s'")["value"].to_numpy()
+    loss_g = supp_g1.query("param == 'loss_s'")["value"].to_numpy() \
+        if supp_g1 is not None and len(supp_g1) else np.array([])
+
+    # tau recovery against the generative truth (sanity that the run is
+    # a real fit, not a degenerate one)
+    per_cell = cn_s_out.groupby("cell_id").agg(tau=("model_tau", "first"))
+    merged = per_cell.join(truth_s.set_index("cell_id"))
+    tau_corr = float(np.corrcoef(merged["tau"], merged["true_t"])[0, 1])
+
+    dev = jax.devices()[0]
+    out = {
+        "metric": "pert_full_pipeline_wall_seconds",
+        "value": round(t_infer, 2),
+        "unit": f"seconds ({args.cells} S + {args.g1_cells} G1 cells x "
+                f"{num_loci} bins, {args.cn_prior_method}, "
+                f"max_iter={args.max_iter}, incl. compile + priors + "
+                f"decode + packaging)",
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "enum_impl": args.enum_impl,
+        "data_gen_seconds": round(t_data, 2),
+        "cells_per_second_end_to_end": round(args.cells / t_infer, 2),
+        "step2_iters": int(len(loss_s)),
+        "step2_final_loss": float(loss_s[-1]) if len(loss_s) else None,
+        "step2_loss_decreased": bool(len(loss_s)
+                                     and loss_s[-1] < loss_s[0]),
+        "step3_iters": int(len(loss_g)),
+        "tau_truth_correlation": round(tau_corr, 4),
+        "run_step3": bool(args.run_step3),
+        "profile_dir": args.profile_dir,
+    }
+    print(json.dumps(out))
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(out, fh, indent=1)
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cells", type=int, default=1000,
+                    help="S-phase cells (BASELINE.md config 3 scale)")
+    ap.add_argument("--g1-cells", type=int, default=250)
+    ap.add_argument("--max-iter", type=int, default=800)
+    ap.add_argument("--min-iter", type=int, default=100)
+    ap.add_argument("--cn-prior-method", default="g1_clones")
+    ap.add_argument("--enum-impl", default="auto")
+    ap.add_argument("--run-step3", action="store_true")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--profile-dir", default=None)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+    run(args)
+
+
+if __name__ == "__main__":
+    main()
