@@ -38,18 +38,31 @@ def probe_backend(timeout=150, retries=2):
     nothing was recorded.  The probe therefore initializes the ambient
     backend in a SUBPROCESS under a hard timeout, retries once, and falls
     back to CPU so a number always lands.
+
+    Returns ``(platform, attempts)``: ``attempts`` records each probe's
+    outcome (rc / stderr tail / timeout) so a ``cpu_fallback`` artifact
+    carries WHY the accelerator probe failed — round 4's artifact recorded
+    a silent downgrade and the environment flake was indistinguishable
+    from a code regression.
     """
     code = "import jax; print(jax.devices()[0].platform)"
-    for _ in range(retries):
+    attempts = []
+    for i in range(retries):
         try:
             out = subprocess.run([sys.executable, "-c", code],
                                  capture_output=True, text=True,
                                  timeout=timeout)
         except subprocess.TimeoutExpired:
+            attempts.append({"attempt": i + 1,
+                             "outcome": f"timeout after {timeout}s"})
             continue
         if out.returncode == 0 and out.stdout.strip():
-            return out.stdout.strip().splitlines()[-1]
-    return "cpu_fallback"
+            attempts.append({"attempt": i + 1, "outcome": "ok"})
+            return out.stdout.strip().splitlines()[-1], attempts
+        attempts.append({"attempt": i + 1,
+                         "outcome": f"rc={out.returncode}",
+                         "stderr_tail": out.stderr.strip()[-400:]})
+    return "cpu_fallback", attempts
 
 
 def _problem(num_cells, num_loci, P, K, seed=0):
@@ -246,10 +259,12 @@ def _parse_args(argv=None):
                     help="'auto' probes the ambient backend in a "
                          "subprocess and falls back to cpu")
     ap.add_argument("--probe-timeout", type=int, default=150)
+    ap.add_argument("--fallback-reason", default=None,
+                    help=argparse.SUPPRESS)  # set by the re-exec path only
     return ap.parse_args(argv)
 
 
-def _run(args, platform):
+def _run(args, platform, probe_attempts=None):
     """Run the benchmark on an already-decided platform; emit the JSON."""
     on_cpu = platform.startswith("cpu")
     iters = min(args.iters, args.cpu_iters) if on_cpu else args.iters
@@ -312,6 +327,10 @@ def _run(args, platform):
                          "(pyro-ppl is not installable here), not a "
                          "recorded Pyro run; treat the ratio as "
                          "hardware-relative, not reference-exact",
+        # how the platform was decided (None = forced via --platform);
+        # a cpu_fallback artifact must be auditable back to its cause
+        "probe": probe_attempts,
+        "fallback_reason": args.fallback_reason,
     }))
 
 
@@ -319,8 +338,9 @@ def main():
     args = _parse_args()
 
     platform = args.platform
+    probe_attempts = None
     if platform == "auto":
-        platform = probe_backend(timeout=args.probe_timeout)
+        platform, probe_attempts = probe_backend(timeout=args.probe_timeout)
     if platform.startswith("cpu"):
         # must land before the first device access; jax may be
         # pre-imported (sitecustomize), so override the live config too
@@ -329,17 +349,19 @@ def main():
         jax.config.update("jax_platforms", "cpu")
 
     try:
-        _run(args, platform)
+        _run(args, platform, probe_attempts)
     except Exception as exc:  # noqa: BLE001 — a number must always land
         if platform.startswith("cpu"):
             raise  # CPU is the floor; nothing further to fall back to
         # accelerator path died mid-run (compile error, OOM, tunnel drop):
         # re-exec on CPU in a fresh process so stale backend state can't
-        # leak, and forward its JSON line
+        # leak, and forward its JSON line (with the cause recorded)
         print(f"bench: {platform} run failed ({exc!r}); "
               "re-running on cpu fallback", file=sys.stderr)
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         argv = [sys.executable, __file__, "--platform", "cpu",
+                "--fallback-reason",
+                (f"{platform} run failed: {exc!r}")[:400],
                 "--cells", str(args.cells), "--loci", str(args.loci),
                 "--P", str(args.P), "--K", str(args.K),
                 "--iters", str(args.iters),

@@ -24,6 +24,15 @@ from typing import Optional
 
 import numpy as np
 
+# Format history (the pi_logits layout contract lives in layout.py):
+#   v2  pi_logits stored STATE-MAJOR (P, cells, loci)
+#   v1  (never stamped) pi_logits cells-major — round <= 3 checkpoints;
+#       round-4 snapshots confusingly wrote state-major WITHOUT a stamp,
+#       so an unstamped 3-D pi_logits is AMBIGUOUS and load_step refuses
+#       it rather than guessing (a wrong guess trains on a transposed
+#       tensor); delete the stale .npz and refit.
+CHECKPOINT_FORMAT_VERSION = 2
+
 
 def save_step(checkpoint_dir: str, step: str, params: dict,
               losses: np.ndarray, extra: Optional[dict] = None,
@@ -33,6 +42,8 @@ def save_step(checkpoint_dir: str, step: str, params: dict,
     path = os.path.join(checkpoint_dir, f"pert_{step}.npz")
     flat = {f"param.{k}": np.asarray(v) for k, v in params.items()}
     flat["losses"] = np.asarray(losses)
+    # v2 = pi_logits stored state-major (P, cells, loci); see layout.py
+    flat["meta.format_version"] = np.asarray(CHECKPOINT_FORMAT_VERSION)
     flat["meta.num_iters"] = np.asarray(
         num_iters if num_iters is not None else len(losses))
     flat["meta.converged"] = np.asarray(bool(converged))
@@ -67,6 +78,14 @@ def load_step(checkpoint_dir: str, step: str):
     for k in data.files:
         if k.startswith("meta.") or k.startswith("opt."):
             extra[k] = data[k]
+    version = int(extra.get("meta.format_version", 1))
+    if version < 2 and "pi_logits" in params and params["pi_logits"].ndim == 3:
+        raise ValueError(
+            f"{path} has no format_version stamp: its pi_logits layout is "
+            "ambiguous (pre-v2 checkpoints exist in BOTH cells-major and "
+            "state-major orientations) and restoring a transposed tensor "
+            "would silently corrupt training — delete the stale "
+            "checkpoint file and refit")
     return params, data["losses"], extra
 
 

@@ -1,0 +1,128 @@
+"""Single owner of the tensor-layout contract and every PartitionSpec.
+
+The PERT model carries two (cells, loci, P)-sized tensors — the Dirichlet
+CN prior concentrations ``etas`` and the variational simplex parameter
+``pi_logits``.  Round 4 introduced a STATE-MAJOR ``(P, cells, loci)``
+layout for the tensors the fused Pallas kernel consumes (each state slice
+is then a well-tiled (cells, loci) block and no per-iteration transpose of
+the ~26x-data-size tensor is needed in either AD pass), but left the
+convention implicitly duplicated across five modules — and an incomplete
+migration broke all of them at once.  This module is now the one place
+that knows the convention:
+
+* ``pi_logits`` (the trained parameter) is ALWAYS state-major
+  ``(P, cells, loci)`` — from ``init_params`` through the optimiser,
+  checkpoints (format v2) and the fused kernel.
+* ``etas`` is stored cells-major ``(cells, loci, P)`` in ``PertBatch``
+  (its host producers and the ploidy/prior consumers are row-per-cell);
+  the fused path transposes it ONCE via :func:`state_major` — the value
+  is fit-constant, so XLA's loop-invariant code motion hoists the
+  transpose out of the compiled training loop.
+* ``log_pi`` handed to decode / the XLA enumeration path is cells-major
+  ``(cells, loci, P)`` (reference convention, pert_model.py:608-646).
+
+Every ``jax.sharding.PartitionSpec`` in the package is built here so the
+mesh placement (``parallel.mesh``) and the ``shard_map`` call sites
+(``models.pert``) can never disagree about which axis is which.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+CELLS_AXIS = "cells"
+LOCI_AXIS = "loci"
+
+
+def state_major(x: Optional[jnp.ndarray]) -> Optional[jnp.ndarray]:
+    """(cells, loci, P) -> (P, cells, loci)."""
+    return None if x is None else jnp.transpose(x, (2, 0, 1))
+
+
+def cells_major(x: Optional[jnp.ndarray]) -> Optional[jnp.ndarray]:
+    """(P, cells, loci) -> (cells, loci, P)."""
+    return None if x is None else jnp.transpose(x, (1, 2, 0))
+
+
+def mesh_axes(mesh: Mesh) -> Tuple[str, Optional[str]]:
+    """(cells_axis, loci_axis_or_None) of a 1-D or 2-D PERT mesh."""
+    cells = mesh.axis_names[0]
+    lx = mesh.axis_names[1] if len(mesh.axis_names) > 1 else None
+    return cells, lx
+
+
+def bin_spec(cells: str, lx: Optional[str]) -> P:
+    """Spec of a (cells, loci) per-bin tensor."""
+    return P(cells, lx)
+
+
+def state_major_spec(cells: str, lx: Optional[str]) -> P:
+    """Spec of a STATE-MAJOR (P, cells, loci) tensor: the state axis is
+    tiny (P=13) and never sharded."""
+    return P(None, cells, lx)
+
+
+def cells_major_state_spec(cells: str, lx: Optional[str]) -> P:
+    """Spec of a cells-major (cells, loci, P) tensor (etas in PertBatch,
+    log_pi on the XLA path)."""
+    return P(cells, lx, None)
+
+
+def batch_specs(lx: Optional[str]) -> dict:
+    """PertBatch field name -> PartitionSpec (parallel.mesh.shard_batch)."""
+    cells = P(CELLS_AXIS)
+    bins = bin_spec(CELLS_AXIS, lx)
+    return {
+        "reads": bins,
+        "libs": cells,
+        "gamma_feats": P(lx, None),
+        "mask": cells,
+        "etas": cells_major_state_spec(CELLS_AXIS, lx),
+        "cn_obs": bins,
+        "rep_obs": bins,
+        "t_alpha": cells,
+        "t_beta": cells,
+        "loci_mask": P(lx),
+    }
+
+
+def param_specs(lx: Optional[str]) -> dict:
+    """Parameter name -> PartitionSpec (parallel.mesh.shard_params).
+
+    Per-cell/per-locus parameters shard; globals replicate (their
+    gradients become XLA-inserted all-reduces).
+    """
+    return {
+        "a_raw": P(),
+        "lamb_raw": P(),
+        "beta_means": P(),
+        "beta_stds_raw": P(),
+        "rho_raw": P(lx),
+        "tau_raw": P(CELLS_AXIS),
+        "u": P(CELLS_AXIS),
+        "betas": P(CELLS_AXIS, None),
+        "pi_logits": state_major_spec(CELLS_AXIS, lx),
+    }
+
+
+def enum_shard_specs(mesh: Mesh):
+    """(in_specs, out_specs) for shard_map over ``enum_loglik``:
+    (reads, mu, log_pi[cells-major], phi, lamb) -> ll."""
+    cells, lx = mesh_axes(mesh)
+    in_specs = (bin_spec(cells, lx), bin_spec(cells, lx),
+                cells_major_state_spec(cells, lx), bin_spec(cells, lx), P())
+    return in_specs, bin_spec(cells, lx)
+
+
+def fused_shard_specs(mesh: Mesh):
+    """(in_specs, out_specs) for shard_map over ``enum_loglik_fused``:
+    (reads, mu, pi_logits[STATE-major], phi, etas[STATE-major], lamb)
+    -> ll."""
+    cells, lx = mesh_axes(mesh)
+    in_specs = (bin_spec(cells, lx), bin_spec(cells, lx),
+                state_major_spec(cells, lx), bin_spec(cells, lx),
+                state_major_spec(cells, lx), P())
+    return in_specs, bin_spec(cells, lx)

@@ -51,6 +51,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.scipy.special import gammaln, logsumexp
 
+from scdna_replication_tools_tpu.layout import (
+    cells_major,
+    enum_shard_specs,
+    fused_shard_specs,
+    state_major,
+)
 from scdna_replication_tools_tpu.ops.dists import (
     bernoulli_log_prob,
     beta_log_prob,
@@ -205,16 +211,17 @@ def init_params(spec: PertModelSpec, batch: PertBatch, fixed: dict,
     beta_means0 = fixed["beta_means"] if spec.cond_beta_means else params["beta_means"]
     params["betas"] = jnp.asarray(beta_means0)[batch.libs].astype(jnp.float32)
 
-    # pi_logits is stored STATE-MAJOR (P, cells, loci): the fused Pallas
-    # kernel consumes per-state (cells, loci) tiles, and a cells-major
-    # layout would cost a ~full-tensor transpose in BOTH passes of every
-    # SVI iteration (pi changes each step, so XLA cannot hoist it) plus a
-    # third for the returned gradient — at genome scale more HBM traffic
-    # than the kernel itself.
+    # pi_logits is stored STATE-MAJOR (P, cells, loci) — layout.py owns
+    # the convention: the fused Pallas kernel consumes per-state
+    # (cells, loci) tiles, and a cells-major layout would cost a
+    # ~full-tensor transpose in BOTH passes of every SVI iteration (pi
+    # changes each step, so XLA cannot hoist it) plus a third for the
+    # returned gradient — at genome scale more HBM traffic than the
+    # kernel itself.
     if not spec.step1 and batch.etas is not None:
         pi0 = batch.etas / jnp.sum(batch.etas, axis=-1, keepdims=True)
-        params["pi_logits"] = jnp.transpose(
-            jnp.log(jnp.clip(pi0, 1e-30, None)), (2, 0, 1))
+        params["pi_logits"] = state_major(
+            jnp.log(jnp.clip(pi0, 1e-30, None)))
     else:
         params["pi_logits"] = jnp.zeros((spec.P, num_cells, num_loci),
                                         jnp.float32)
@@ -261,8 +268,8 @@ def constrained(spec: PertModelSpec, params: dict, fixed: dict) -> dict:
     # The parameter is state-major (P, cells, loci) — see init_params;
     # out["log_pi"] keeps the (cells, loci, P) convention its consumers
     # (decode, step-1 gather, XLA enum path) expect.
-    out["log_pi"] = jnp.transpose(
-        jax.nn.log_softmax(params["pi_logits"], axis=0), (1, 2, 0))
+    out["log_pi"] = cells_major(
+        jax.nn.log_softmax(params["pi_logits"], axis=0))
     out["pi"] = jnp.exp(out["log_pi"])
     return out
 
@@ -348,6 +355,17 @@ def _enum_bin_loglik(spec, reads, u, omega, log_pi, phi, lamb, log_lamb,
     device invokes the kernel on its local (cells/n, loci) shard — the op
     is pointwise over cells, so no collectives are needed and the output
     keeps the input sharding.
+
+    NOTE on the unfused Pallas branch below: production training routes
+    every enumerated fit to ``_enum_bin_loglik_fused`` (log_joint folds
+    the Dirichlet data term into the kernel), so the unfused kernel is
+    never hit by the runner.  It stays deliberately: it is the likelihood
+    WITHOUT the Dirichlet fold — the building block for any future
+    consumer that needs enumerated log-likelihoods alone (e.g. held-out
+    scoring, per-bin likelihood diagnostics, or a non-Dirichlet prior);
+    it is pinned by the kernel parity tests (tests/test_enum_kernel.py),
+    and its VJP is the minimal template the fused kernel's backward was
+    derived from.
     """
     if spec.enum_impl in ("pallas", "pallas_interpret"):
         _require_fixed_lamb(spec)
@@ -356,15 +374,12 @@ def _enum_bin_loglik(spec, reads, u, omega, log_pi, phi, lamb, log_lamb,
         interpret = spec.enum_impl == "pallas_interpret"
         if mesh is None:
             return enum_loglik(reads, mu, log_pi, phi, lamb, interpret)
-        from jax.sharding import PartitionSpec as PS
-        cells = mesh.axis_names[0]
-        lx = mesh.axis_names[1] if len(mesh.axis_names) > 1 else None
+        in_specs, out_specs = enum_shard_specs(mesh)
         fn = jax.shard_map(
             functools.partial(enum_loglik, interpret=interpret),
             mesh=mesh,
-            in_specs=(PS(cells, lx), PS(cells, lx),
-                      PS(cells, lx, None), PS(cells, lx), PS()),
-            out_specs=PS(cells, lx),
+            in_specs=in_specs,
+            out_specs=out_specs,
             # pallas_call's out_shape carries no varying-mesh-axes info;
             # skip the vma check (the op is pointwise over cells)
             check_vma=False,
@@ -388,35 +403,35 @@ def _require_fixed_lamb(spec):
             "kernel does not differentiate through lambda")
 
 
-def _enum_bin_loglik_fused(spec, reads, u, omega, pi_logits, phi, etas,
+def _enum_bin_loglik_fused(spec, reads, u, omega, pi_logits_t, phi, etas_t,
                            lamb, mesh=None):
     """(cells, loci) fused objective: enumerated bin log-likelihood PLUS
     the Dirichlet data term sum_s (etas_s - 1) * log_softmax(pi)_s.
 
-    The Pallas kernel normalises pi_logits per-tile in VMEM, so the
-    (cells, loci, P) log_pi tensor and its softmax-Jacobian backward pass
-    never touch HBM — the dominant per-iteration traffic of the step-2
-    objective at genome scale (see ops/enum_kernel.py).
+    ``pi_logits_t``/``etas_t`` are STATE-MAJOR ``(P, cells, loci)`` — the
+    kernel's input contract (layout.py owns the convention; the kernel
+    raises on any other shape).  The Pallas kernel normalises pi_logits
+    per-tile in VMEM, so the (cells, loci, P) log_pi tensor and its
+    softmax-Jacobian backward pass never touch HBM — the dominant
+    per-iteration traffic of the step-2 objective at genome scale (see
+    ops/enum_kernel.py).
     """
     _require_fixed_lamb(spec)
     from scdna_replication_tools_tpu.ops.enum_kernel import enum_loglik_fused
     mu = u[:, None] * omega
     interpret = spec.enum_impl == "pallas_interpret"
     if mesh is None:
-        return enum_loglik_fused(reads, mu, pi_logits, phi, etas, lamb,
+        return enum_loglik_fused(reads, mu, pi_logits_t, phi, etas_t, lamb,
                                  interpret)
-    from jax.sharding import PartitionSpec as PS
-    cells = mesh.axis_names[0]
-    lx = mesh.axis_names[1] if len(mesh.axis_names) > 1 else None
+    in_specs, out_specs = fused_shard_specs(mesh)
     fn = jax.shard_map(
         functools.partial(enum_loglik_fused, interpret=interpret),
         mesh=mesh,
-        in_specs=(PS(cells, lx), PS(cells, lx), PS(cells, lx, None),
-                  PS(cells, lx), PS(cells, lx, None), PS()),
-        out_specs=PS(cells, lx),
+        in_specs=in_specs,
+        out_specs=out_specs,
         check_vma=False,
     )
-    return fn(reads, mu, pi_logits, phi, etas, lamb)
+    return fn(reads, mu, pi_logits_t, phi, etas_t, lamb)
 
 
 def _observed_bin_loglik(spec, reads, u, omega, log_pi, phi, cn_obs, rep_obs,
@@ -463,6 +478,10 @@ def log_joint(spec: PertModelSpec, params: dict, fixed: dict,
         lp_pi = gammaln(jnp.sum(etas, axis=-1)) \
             - jnp.sum(gammaln(etas), axis=-1)
         pi_like = params["pi_logits"]
+        # the kernel consumes etas STATE-MAJOR like pi_logits; etas is
+        # fit-constant, so XLA's loop-invariant code motion hoists this
+        # transpose out of the compiled training while-loop
+        etas_sm = state_major(etas)
     else:
         log_pi = c["log_pi"]
         # parenthesisation matters: the two gammaln terms are ~1.3e7 at
@@ -493,7 +512,7 @@ def log_joint(spec: PertModelSpec, params: dict, fixed: dict,
 
     if spec.cell_chunk is None:
         ll = bin_ll(batch.reads, c["u"], omega, pi_like, phi,
-                    batch.cn_obs, batch.rep_obs, etas if fused else None)
+                    batch.cn_obs, batch.rep_obs, etas_sm if fused else None)
         lp += jnp.sum(ll * mask[:, None] * lmask[None, :])
     else:
         # chunk the cells axis through lax.map so only a
@@ -506,9 +525,19 @@ def log_joint(spec: PertModelSpec, params: dict, fixed: dict,
         def _r(x):
             return None if x is None else x.reshape((nch, ch) + x.shape[1:])
 
-        chunks = (_r(batch.reads), _r(c["u"]), _r(omega), _r(pi_like),
+        def _r_sm(x):
+            # STATE-MAJOR (P, cells, loci): the cells axis is axis 1, so
+            # chunk there and lead with the chunk axis for lax.map —
+            # each mapped slab keeps the kernel's (P, chunk, loci) contract
+            if x is None:
+                return None
+            return jnp.moveaxis(
+                x.reshape(x.shape[0], nch, ch, x.shape[2]), 1, 0)
+
+        pi_chunked = _r_sm(pi_like) if fused else _r(pi_like)
+        chunks = (_r(batch.reads), _r(c["u"]), _r(omega), pi_chunked,
                   _r(phi), _r(batch.cn_obs), _r(batch.rep_obs), _r(mask),
-                  _r(etas if fused else None))
+                  _r_sm(etas_sm) if fused else None)
 
         def body(args):
             reads, u, omega_, pi_, phi_, cn_obs, rep_obs, m, etas_ = args

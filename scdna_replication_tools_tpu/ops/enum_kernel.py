@@ -245,6 +245,12 @@ def _scalars(lamb):
 
 def _enum_fwd(reads, mu, log_pi, phi, lamb, interpret):
     C, L = reads.shape
+    if log_pi.ndim != 3 or log_pi.shape[:2] != reads.shape:
+        raise ValueError(
+            "enum_loglik expects CELLS-MAJOR log_pi of shape "
+            f"(cells, loci, P) = {reads.shape + ('P',)}; got "
+            f"{log_pi.shape} (state-major input belongs to "
+            "enum_loglik_fused)")
     P = log_pi.shape[-1]
     scal, reads_p, mu_p, phi_p, log_pi_p = _prep(reads, mu, log_pi, phi, lamb)
     nc, nl = reads_p.shape
@@ -454,6 +460,17 @@ def _prep_fused(reads, mu, pi_logits_t, phi, etas_t, lamb):
 
 def _fused_fwd(reads, mu, pi_logits_t, phi, etas_t, lamb, interpret):
     C, L = reads.shape
+    # the layout contract is load-bearing: a cells-major (C, L, P) tensor
+    # fed here would be padded and state-looped over the WRONG axis and
+    # produce silent garbage — fail loudly instead (layout.py owns the
+    # convention)
+    if pi_logits_t.ndim != 3 or pi_logits_t.shape[1:] != reads.shape \
+            or etas_t.shape != pi_logits_t.shape:
+        raise ValueError(
+            "enum_loglik_fused expects STATE-MAJOR pi_logits_t and etas_t "
+            f"of shape (P,) + reads.shape = ('P',) + {reads.shape}; got "
+            f"pi_logits_t {pi_logits_t.shape}, etas_t {etas_t.shape} "
+            "(transpose cells-major tensors with layout.state_major)")
     P = pi_logits_t.shape[0]
     scal, reads_p, mu_p, phi_p, pi_p, etas_p = _prep_fused(
         reads, mu, pi_logits_t, phi, etas_t, lamb)

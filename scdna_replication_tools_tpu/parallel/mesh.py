@@ -26,6 +26,11 @@ collectives in user code, per the scaling-book recipe: pick a mesh,
 annotate shardings, let XLA insert the collectives.  The one exception is
 the fused Pallas kernel, which runs per-device under ``shard_map``
 (models/pert._enum_bin_loglik) with specs built from the same axis names.
+
+Every PartitionSpec here comes from ``scdna_replication_tools_tpu.layout``
+— the single owner of the tensor-layout contract (notably: pi_logits is
+STATE-MAJOR ``(P, cells, loci)``) — so this module cannot drift from the
+shard_map call sites in ``models.pert``.
 """
 
 from __future__ import annotations
@@ -34,12 +39,11 @@ from typing import Optional
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
 
+from scdna_replication_tools_tpu import layout
+from scdna_replication_tools_tpu.layout import CELLS_AXIS, LOCI_AXIS
 from scdna_replication_tools_tpu.models.pert import PertBatch
-
-CELLS_AXIS = "cells"
-LOCI_AXIS = "loci"
 
 
 def make_mesh(num_devices: Optional[int] = None, devices=None,
@@ -74,7 +78,7 @@ def loci_axis(mesh: Mesh) -> Optional[str]:
     return LOCI_AXIS if LOCI_AXIS in mesh.axis_names else None
 
 
-def _put(mesh: Mesh, x, spec: P):
+def _put(mesh: Mesh, x, spec):
     if x is None:
         return None
     return jax.device_put(x, NamedSharding(mesh, spec))
@@ -82,41 +86,15 @@ def _put(mesh: Mesh, x, spec: P):
 
 def shard_batch(mesh: Mesh, batch: PertBatch) -> PertBatch:
     """Place a PertBatch on the mesh: cells (and optionally loci) sharded."""
-    lx = loci_axis(mesh)
-    cells = P(CELLS_AXIS)
-    cells_loci = P(CELLS_AXIS, lx)
-    return PertBatch(
-        reads=_put(mesh, batch.reads, cells_loci),
-        libs=_put(mesh, batch.libs, cells),
-        gamma_feats=_put(mesh, batch.gamma_feats, P(lx, None)),
-        mask=_put(mesh, batch.mask, cells),
-        etas=_put(mesh, batch.etas, P(CELLS_AXIS, lx, None)),
-        cn_obs=_put(mesh, batch.cn_obs, cells_loci),
-        rep_obs=_put(mesh, batch.rep_obs, cells_loci),
-        t_alpha=_put(mesh, batch.t_alpha, cells),
-        t_beta=_put(mesh, batch.t_beta, cells),
-        loci_mask=_put(mesh, batch.loci_mask, P(lx)),
-    )
-
-
-def _param_specs(mesh: Mesh) -> dict:
-    """Parameter name -> PartitionSpec for this mesh."""
-    lx = loci_axis(mesh)
-    return {
-        "a_raw": P(),
-        "lamb_raw": P(),
-        "beta_means": P(),
-        "beta_stds_raw": P(),
-        "rho_raw": P(lx),
-        "tau_raw": P(CELLS_AXIS),
-        "u": P(CELLS_AXIS),
-        "betas": P(CELLS_AXIS, None),
-        "pi_logits": P(CELLS_AXIS, lx, None),
-    }
+    specs = layout.batch_specs(loci_axis(mesh))
+    return PertBatch(**{
+        name: _put(mesh, getattr(batch, name), spec)
+        for name, spec in specs.items()
+    })
 
 
 def shard_params(mesh: Mesh, params: dict) -> dict:
     """Place the parameter pytree: per-cell/per-locus params sharded,
-    globals replicated."""
-    specs = _param_specs(mesh)
+    globals replicated (specs owned by ``layout.param_specs``)."""
+    specs = layout.param_specs(loci_axis(mesh))
     return {k: _put(mesh, v, specs[k]) for k, v in params.items()}

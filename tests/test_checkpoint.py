@@ -30,6 +30,24 @@ def test_save_load_roundtrip(tmp_path):
     assert ckpt.load_step(str(tmp_path), "step3") is None
 
 
+def test_unstamped_pi_logits_checkpoint_is_refused(tmp_path):
+    """Pre-v2 checkpoints carry no format_version; their pi_logits layout
+    is ambiguous (cells-major in rounds <=3, state-major in round-4
+    snapshots) — load_step must refuse rather than guess and silently
+    train a transposed tensor."""
+    import pytest
+
+    params = {"pi_logits": np.zeros((13, 8, 32), np.float32)}
+    path = ckpt.save_step(str(tmp_path), "step2", params,
+                          np.array([1.0], np.float32))
+    # strip the stamp to fabricate a legacy file
+    data = dict(np.load(path))
+    del data["meta.format_version"]
+    np.savez(path, **data)
+    with pytest.raises(ValueError, match="format_version"):
+        ckpt.load_step(str(tmp_path), "step2")
+
+
 def test_partial_fit_resume_is_exact(tmp_path, synthetic_frames):
     """A step-2 fit killed mid-budget must, on resume, land on exactly the
     uninterrupted run's trajectory: Adam moments + loss history + params

@@ -14,6 +14,7 @@ import pytest
 from scipy.special import digamma as sp_digamma
 from scipy.special import gammaln as sp_gammaln
 
+from scdna_replication_tools_tpu.layout import state_major
 from scdna_replication_tools_tpu.models.pert import (
     PertBatch,
     PertModelSpec,
@@ -129,14 +130,20 @@ def test_fused_gradient_parity_with_xla_oracle(etas_kind):
     def loss(fn, mu, logits, phi):
         return jnp.sum(fn(reads, mu, logits, phi, etas, lamb) * w)
 
+    def fused_cm(reads, mu, logits, phi, etas, lamb):
+        # the kernel's contract is STATE-MAJOR (P, C, L); the oracle stays
+        # cells-major, so transpose inside the differentiated function —
+        # jax maps the dpi cotangent back through the transpose for us
+        return enum_loglik_fused(reads, mu, state_major(logits), phi,
+                                 state_major(etas), lamb, True)
+
     g_ref = jax.grad(lambda *a: loss(_fused_xla_oracle, *a), (0, 1, 2))(
         mu, logits, phi)
-    g_pal = jax.grad(
-        lambda *a: loss(lambda *b: enum_loglik_fused(*b, True), *a),
-        (0, 1, 2))(mu, logits, phi)
+    g_pal = jax.grad(lambda *a: loss(fused_cm, *a), (0, 1, 2))(
+        mu, logits, phi)
 
     out_ref = _fused_xla_oracle(reads, mu, logits, phi, etas, lamb)
-    out_pal = enum_loglik_fused(reads, mu, logits, phi, etas, lamb, True)
+    out_pal = fused_cm(reads, mu, logits, phi, etas, lamb)
     fwd_rel = jnp.max(jnp.abs(out_ref - out_pal)) \
         / (jnp.max(jnp.abs(out_ref)) + 1e-30)
     assert float(fwd_rel) < 1e-4, float(fwd_rel)
@@ -144,6 +151,18 @@ def test_fused_gradient_parity_with_xla_oracle(etas_kind):
     for name, a, b in zip(("dmu", "dpi_logits", "dphi"), g_ref, g_pal):
         rel = jnp.max(jnp.abs(a - b)) / (jnp.max(jnp.abs(a)) + 1e-30)
         assert float(rel) < 2e-2, (name, float(rel))
+
+
+def test_layout_contract_raises_on_cells_major_input():
+    """Feeding the fused kernel the old cells-major layout (round 4's
+    regression: silent NaN garbage) must raise, not compute."""
+    reads, mu, logits, phi, lamb = _problem(C=8, L=96)
+    etas = jnp.ones_like(logits)
+    with pytest.raises(ValueError, match="STATE-MAJOR"):
+        enum_loglik_fused(reads, mu, logits, phi, etas, lamb, True)
+    # and the unfused kernel rejects state-major input symmetrically
+    with pytest.raises(ValueError, match="CELLS-MAJOR"):
+        enum_loglik(reads, mu, state_major(logits), phi, lamb, True)
 
 
 def test_pert_loss_parity_between_impls():
