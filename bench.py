@@ -76,7 +76,8 @@ def _problem(num_cells, num_loci, P, K, seed=0):
     return reads, gammas, etas, t_init
 
 
-def bench_jax(num_cells, num_loci, P, K, iters, enum_impl="auto"):
+def bench_jax(num_cells, num_loci, P, K, iters, enum_impl="auto",
+              sparse=False):
     import jax
     import jax.numpy as jnp
     import optax
@@ -95,13 +96,17 @@ def bench_jax(num_cells, num_loci, P, K, iters, enum_impl="auto"):
     reads, gammas, etas, t_init = _problem(num_cells, num_loci, P, K)
     spec = PertModelSpec(P=P, K=K, L=1, tau_mode="param",
                          cond_beta_means=True, fixed_lamb=True,
-                         enum_impl=enum_impl)
+                         sparse_etas=sparse, enum_impl=enum_impl)
+    from scdna_replication_tools_tpu.models.priors import eta_batch_fields
+    eta_fields = eta_batch_fields(etas, allow_sparse=sparse)
+    if sparse and "eta_idx" not in eta_fields:
+        raise RuntimeError("bench prior unexpectedly failed to sparsify")
     batch = PertBatch(
         reads=jnp.asarray(reads),
         libs=jnp.zeros((num_cells,), jnp.int32),
         gamma_feats=gc_features(jnp.asarray(gammas), K),
         mask=jnp.ones((num_cells,), jnp.float32),
-        etas=jnp.asarray(etas),
+        **eta_fields,
     )
     fixed = {"beta_means": jnp.zeros((1, K + 1), jnp.float32),
              "lamb": jnp.asarray(0.75, jnp.float32)}
@@ -272,18 +277,22 @@ def _run(args, platform, probe_attempts=None):
     from scdna_replication_tools_tpu.ops.enum_kernel import resolve_enum_impl
     impl = resolve_enum_impl(args.enum_impl)
     if args.enum_impl == "auto" and impl == "pallas":
-        # on TPU, race the fused kernel against the XLA broadcast path and
-        # record the faster production configuration
-        candidates = ["pallas", "xla"]
+        # on TPU, race the production configuration (fused kernel with the
+        # sparse one-hot prior encoding — what the runner auto-selects)
+        # against the dense-etas kernel and the XLA broadcast path
+        candidates = ["pallas_sparse", "pallas", "xla"]
     else:
         candidates = [impl]
 
     jax_per_iter, winner, errors = float("inf"), None, []
     candidate_secs = {}
     for cand in candidates:
+        sparse = cand == "pallas_sparse"
         try:
             per_iter, _ = bench_jax(args.cells, args.loci, args.P, args.K,
-                                    iters, enum_impl=cand)
+                                    iters,
+                                    enum_impl="pallas" if sparse else cand,
+                                    sparse=sparse)
         except Exception as exc:  # noqa: BLE001 — one candidate failing
             # (e.g. a Pallas/Mosaic compile error) must not forfeit a
             # working sibling path on the same accelerator
@@ -314,7 +323,11 @@ def _run(args, platform, probe_attempts=None):
                 f"enumerated SVI step)",
         "vs_baseline": round(vs, 2),
         "platform": platform,
-        "enum_impl": winner,
+        # enum_impl round-trips into PertConfig.enum_impl; the sparse
+        # winner is the same kernel with PertConfig.sparse_etas=True
+        "enum_impl": "pallas" if winner == "pallas_sparse" else winner,
+        "sparse_etas": winner == "pallas_sparse",
+        "winner": winner,
         # every candidate's steady-state seconds/iter (None = failed), so
         # the recorded artifact shows both production paths, not only the
         # winner

@@ -104,6 +104,11 @@ class PertConfig:
     # mesh is active) and the XLA broadcast path elsewhere; 'xla' /
     # 'pallas' / 'pallas_interpret' force a specific path.
     enum_impl: str = "auto"
+    # auto-compact one-hot CN priors (priors.sparsify_etas) to
+    # (eta_idx, eta_w) planes, cutting the fused kernel's per-iteration
+    # etas HBM stream from 2P planes to 4; False keeps the dense tensor
+    # (the composite prior always stays dense — it is multi-state).
+    sparse_etas: bool = True
     # write jax.profiler traces (TensorBoard/Perfetto) of each SVI step
     # fit into this directory; None disables tracing.
     profile_dir: Optional[str] = None

@@ -156,6 +156,13 @@ class PertInference:
     def _gamma_feats(self, data: PertData) -> jnp.ndarray:
         return gc_features(jnp.asarray(data.gammas), self.config.K)
 
+    def _eta_batch_fields(self, etas_padded: np.ndarray) -> dict:
+        """PertBatch kwargs for the CN prior: the compact (eta_idx, eta_w)
+        planes when the prior is one-hot structured (priors.sparsify_etas)
+        and the config allows it, else the dense etas tensor."""
+        return priors.eta_batch_fields(
+            etas_padded, allow_sparse=self.config.sparse_etas)
+
     def _maybe_shard(self, batch: PertBatch, params: dict):
         if self._mesh is None:
             return batch, params
@@ -356,19 +363,21 @@ class PertInference:
             # domain.
             fixed["rho"] = jnp.clip(
                 jnp.asarray(s.rt_prior, jnp.float32), 0.0, 1.0)
+        eta_fields = self._eta_batch_fields(etas_padded)
         batch = PertBatch(
             reads=jnp.asarray(s.reads),
             libs=jnp.asarray(s.libs),
             gamma_feats=self._gamma_feats(s),
             mask=jnp.asarray(s.cell_mask.astype(np.float32)),
-            etas=jnp.asarray(etas_padded),
             loci_mask=_loci_mask_arr(s),
+            **eta_fields,
         )
         spec = PertModelSpec(
             P=self.config.P, K=self.config.K, L=self.L,
             tau_mode="param", step1=False, cond_beta_means=True,
             cond_rho=cond_rho,
-            fixed_lamb=True, cell_chunk=self.config.cell_chunk,
+            fixed_lamb=True, sparse_etas="eta_idx" in eta_fields,
+            cell_chunk=self.config.cell_chunk,
             enum_impl=self._enum_impl())
         out = self._fit(spec, batch, fixed, t_init,
                         iters["max_iter"], iters["min_iter"], "step2")
@@ -395,18 +404,20 @@ class PertInference:
         t_init2 = np.pad(np.asarray(t_init2_real),
                          (0, g1.num_cells - self.g1.num_cells),
                          constant_values=0.4)
+        eta_fields = self._eta_batch_fields(etas2)
         batch = PertBatch(
             reads=jnp.asarray(g1.reads),
             libs=jnp.asarray(g1.libs),
             gamma_feats=self._gamma_feats(g1),
             mask=jnp.asarray(g1.cell_mask.astype(np.float32)),
-            etas=jnp.asarray(etas2),
             loci_mask=_loci_mask_arr(g1),
+            **eta_fields,
         )
         spec = PertModelSpec(
             P=self.config.P, K=self.config.K, L=self.L,
             tau_mode="param", step1=False, cond_beta_means=True,
             cond_rho=True, cond_a=True, fixed_lamb=True,
+            sparse_etas="eta_idx" in eta_fields,
             cell_chunk=self.config.cell_chunk,
             enum_impl=self._enum_impl())
         out = self._fit(spec, batch, fixed, t_init2,

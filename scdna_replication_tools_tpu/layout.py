@@ -81,6 +81,8 @@ def batch_specs(lx: Optional[str]) -> dict:
         "gamma_feats": P(lx, None),
         "mask": cells,
         "etas": cells_major_state_spec(CELLS_AXIS, lx),
+        "eta_idx": bins,
+        "eta_w": bins,
         "cn_obs": bins,
         "rep_obs": bins,
         "t_alpha": cells,
@@ -126,3 +128,14 @@ def fused_shard_specs(mesh: Mesh):
                 state_major_spec(cells, lx), bin_spec(cells, lx),
                 state_major_spec(cells, lx), P())
     return in_specs, bin_spec(cells, lx)
+
+
+def fused_sparse_shard_specs(mesh: Mesh):
+    """(in_specs, out_specs) for shard_map over
+    ``enum_loglik_fused_sparse``: (reads, mu, pi_logits[STATE-major],
+    phi, eta_idx, eta_w, lamb) -> ll."""
+    cells, lx = mesh_axes(mesh)
+    bins = bin_spec(cells, lx)
+    in_specs = (bins, bins, state_major_spec(cells, lx), bins, bins, bins,
+                P())
+    return in_specs, bins

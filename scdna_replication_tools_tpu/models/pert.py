@@ -55,6 +55,7 @@ from scdna_replication_tools_tpu.layout import (
     cells_major,
     enum_shard_specs,
     fused_shard_specs,
+    fused_sparse_shard_specs,
     state_major,
 )
 from scdna_replication_tools_tpu.ops.dists import (
@@ -101,6 +102,12 @@ class PertModelSpec:
     cond_a: bool = False
     # lambda fixed as a plain argument (no site at all) — steps 2/3
     fixed_lamb: bool = False
+    # one-hot Dirichlet prior encoding: the batch carries (eta_idx, eta_w)
+    # (cells, loci) planes instead of the dense (cells, loci, P) etas —
+    # set by the runner when priors.sparsify_etas detects the structure
+    # (every production cn_prior_method except the composite one); cuts
+    # the fused kernel's etas HBM stream from 2P to 4 planes per iteration
+    sparse_etas: bool = False
     cell_chunk: Optional[int] = None
     # enumerated-likelihood implementation: 'xla' (dense broadcast tensor,
     # the fallback + parity oracle), 'pallas' (fused TPU kernel, see
@@ -119,6 +126,9 @@ class PertBatch:
       mask       (cells,) float32 — 1 for real cells, 0 for padding
       loci_mask  (loci,) float32 or None — 1 for real loci (None = all real)
       etas       (cells, loci, P) float32 or None — CN prior concentrations
+      eta_idx    (cells, loci) float32 or None — sparse prior: index of the
+                 bin's one non-unit Dirichlet state (spec.sparse_etas)
+      eta_w      (cells, loci) float32 or None — its concentration minus 1
       cn_obs     (cells, loci) float32 or None — step-1 conditioned CN
       rep_obs    (cells, loci) float32 or None — step-1 conditioned rep
       t_alpha, t_beta (cells,) or None — Beta prior for tau ('beta_prior')
@@ -126,7 +136,7 @@ class PertBatch:
 
     def __init__(self, reads, libs, gamma_feats, mask, etas=None,
                  cn_obs=None, rep_obs=None, t_alpha=None, t_beta=None,
-                 loci_mask=None):
+                 loci_mask=None, eta_idx=None, eta_w=None):
         self.reads = reads
         self.libs = libs
         self.gamma_feats = gamma_feats
@@ -137,11 +147,13 @@ class PertBatch:
         self.t_alpha = t_alpha
         self.t_beta = t_beta
         self.loci_mask = loci_mask
+        self.eta_idx = eta_idx
+        self.eta_w = eta_w
 
     def tree_flatten(self):
         children = (self.reads, self.libs, self.gamma_feats, self.mask,
                     self.etas, self.cn_obs, self.rep_obs, self.t_alpha,
-                    self.t_beta, self.loci_mask)
+                    self.t_beta, self.loci_mask, self.eta_idx, self.eta_w)
         return children, None
 
     def effective_loci_mask(self):
@@ -222,6 +234,13 @@ def init_params(spec: PertModelSpec, batch: PertBatch, fixed: dict,
         pi0 = batch.etas / jnp.sum(batch.etas, axis=-1, keepdims=True)
         params["pi_logits"] = state_major(
             jnp.log(jnp.clip(pi0, 1e-30, None)))
+    elif not spec.step1 and batch.eta_idx is not None:
+        # same init from the sparse encoding, built state-major directly:
+        # pi0_s = (1 + [s == idx] * w) / (P + w)
+        sidx = jnp.arange(spec.P, dtype=jnp.float32)[:, None, None]
+        params["pi_logits"] = (
+            jnp.where(sidx == batch.eta_idx[None], jnp.log1p(batch.eta_w), 0.0)
+            - jnp.log(spec.P + batch.eta_w))
     else:
         params["pi_logits"] = jnp.zeros((spec.P, num_cells, num_loci),
                                         jnp.float32)
@@ -238,9 +257,15 @@ def _cell_ploidies(spec: PertModelSpec, batch: PertBatch) -> jnp.ndarray:
     """Per-cell ploidy guess feeding the u prior (reference:
     pert_model.py:589-600): argmax of etas when provided, else 2.0.
     (cn0 is only ever supplied by the simulator.)"""
-    if batch.etas is not None and not spec.step1:
-        cn_mode = jnp.argmax(batch.etas, axis=-1).astype(jnp.float32)
-        return _loci_mean(cn_mode, batch.effective_loci_mask())
+    if not spec.step1:
+        if batch.etas is not None:
+            cn_mode = jnp.argmax(batch.etas, axis=-1).astype(jnp.float32)
+            return _loci_mean(cn_mode, batch.effective_loci_mask())
+        if batch.eta_idx is not None:
+            # sparse encoding: the non-unit state IS the argmax (w > 0);
+            # w == 0 (uniform bin) argmaxes to state 0 like the dense path
+            cn_mode = jnp.where(batch.eta_w > 0.0, batch.eta_idx, 0.0)
+            return _loci_mean(cn_mode, batch.effective_loci_mask())
     return jnp.full((batch.reads.shape[0],), 2.0, jnp.float32)
 
 
@@ -434,6 +459,32 @@ def _enum_bin_loglik_fused(spec, reads, u, omega, pi_logits_t, phi, etas_t,
     return fn(reads, mu, pi_logits_t, phi, etas_t, lamb)
 
 
+def _enum_bin_loglik_fused_sparse(spec, reads, u, omega, pi_logits_t, phi,
+                                  eta_idx, eta_w, lamb, mesh=None):
+    """Sparse-prior variant of :func:`_enum_bin_loglik_fused`: the
+    Dirichlet data term is ``eta_w * log_softmax(pi)_{eta_idx}`` —
+    (cells, loci) planes instead of the dense (P, cells, loci) etas
+    (see ops/enum_kernel.enum_loglik_fused_sparse)."""
+    _require_fixed_lamb(spec)
+    from scdna_replication_tools_tpu.ops.enum_kernel import (
+        enum_loglik_fused_sparse,
+    )
+    mu = u[:, None] * omega
+    interpret = spec.enum_impl == "pallas_interpret"
+    if mesh is None:
+        return enum_loglik_fused_sparse(reads, mu, pi_logits_t, phi,
+                                        eta_idx, eta_w, lamb, interpret)
+    in_specs, out_specs = fused_sparse_shard_specs(mesh)
+    fn = jax.shard_map(
+        functools.partial(enum_loglik_fused_sparse, interpret=interpret),
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_vma=False,
+    )
+    return fn(reads, mu, pi_logits_t, phi, eta_idx, eta_w, lamb)
+
+
 def _observed_bin_loglik(spec, reads, u, omega, log_pi, phi, cn_obs, rep_obs,
                          lamb, log_lamb, log1m_lamb):
     """(cells, loci) bin log-likelihood with cn/rep conditioned (step 1)."""
@@ -464,8 +515,7 @@ def log_joint(spec: PertModelSpec, params: dict, fixed: dict,
     # pi ~ Dirichlet(etas) per (cell, locus) (reference: pert_model.py:608-611)
     # computed from log_pi: (etas-1)*log_pi is finite because log_softmax
     # never returns -inf, unlike log(softmax)
-    etas = batch.etas if batch.etas is not None else \
-        jnp.ones((num_cells, num_loci, spec.P), jnp.float32)
+    #
     # fused path: the enumerated steps on the Pallas kernel fold both the
     # log_softmax normalisation and the Dirichlet data term
     # sum_s (etas_s - 1) * log_pi_s into the kernel, so log_pi is never
@@ -474,36 +524,69 @@ def log_joint(spec: PertModelSpec, params: dict, fixed: dict,
     # of the compiled while-loop)
     fused = (not spec.step1) and spec.enum_impl in ("pallas",
                                                     "pallas_interpret")
-    if fused:
-        lp_pi = gammaln(jnp.sum(etas, axis=-1)) \
-            - jnp.sum(gammaln(etas), axis=-1)
-        pi_like = params["pi_logits"]
-        # the kernel consumes etas STATE-MAJOR like pi_logits; etas is
-        # fit-constant, so XLA's loop-invariant code motion hoists this
-        # transpose out of the compiled training while-loop
-        etas_sm = state_major(etas)
+    sparse = spec.sparse_etas and not spec.step1
+    eta_idx = eta_w = etas_sm = None
+    if sparse:
+        if batch.eta_idx is None or batch.eta_w is None:
+            raise ValueError(
+                "spec.sparse_etas=True but the batch carries no "
+                "eta_idx/eta_w planes (priors.sparsify_etas builds them)")
+        eta_idx, eta_w = batch.eta_idx, batch.eta_w
+        # one-hot Dirichlet normaliser in analytic form: the dense path's
+        # ~1.3e7-magnitude gammaln cancellation is already done
+        # symbolically here (gammaln(P + w) - gammaln(1 + w) ~ 1e2)
+        lp_pi = gammaln(spec.P + eta_w) - gammaln(1.0 + eta_w)
+        if fused:
+            pi_like = params["pi_logits"]
+        else:
+            log_pi = c["log_pi"]
+            lp_pi = lp_pi + eta_w * jnp.take_along_axis(
+                log_pi, eta_idx.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+            pi_like = log_pi
     else:
-        log_pi = c["log_pi"]
-        # parenthesisation matters: the two gammaln terms are ~1.3e7 at
-        # the default 1e6 concentrations and cancel to ~1e2 — adding the
-        # small data term BEFORE the cancellation would absorb it into
-        # f32 rounding (spacing is 1.0 at that magnitude, ~1 per bin)
-        lp_pi = (
-            jnp.sum((etas - 1.0) * log_pi, axis=-1)
-            + (gammaln(jnp.sum(etas, axis=-1))
-               - jnp.sum(gammaln(etas), axis=-1))
-        )
-        pi_like = log_pi
+        if batch.etas is None and batch.eta_idx is not None:
+            raise ValueError(
+                "batch carries the sparse eta_idx/eta_w encoding but "
+                "spec.sparse_etas=False — the dense path would silently "
+                "fit a uniform CN prior; set sparse_etas=True or provide "
+                "dense etas")
+        etas = batch.etas if batch.etas is not None else \
+            jnp.ones((num_cells, num_loci, spec.P), jnp.float32)
+        if fused:
+            lp_pi = gammaln(jnp.sum(etas, axis=-1)) \
+                - jnp.sum(gammaln(etas), axis=-1)
+            pi_like = params["pi_logits"]
+            # the kernel consumes etas STATE-MAJOR like pi_logits; etas is
+            # fit-constant, so XLA's loop-invariant code motion hoists this
+            # transpose out of the compiled training while-loop
+            etas_sm = state_major(etas)
+        else:
+            log_pi = c["log_pi"]
+            # parenthesisation matters: the two gammaln terms are ~1.3e7 at
+            # the default 1e6 concentrations and cancel to ~1e2 — adding the
+            # small data term BEFORE the cancellation would absorb it into
+            # f32 rounding (spacing is 1.0 at that magnitude, ~1 per bin)
+            lp_pi = (
+                jnp.sum((etas - 1.0) * log_pi, axis=-1)
+                + (gammaln(jnp.sum(etas, axis=-1))
+                   - jnp.sum(gammaln(etas), axis=-1))
+            )
+            pi_like = log_pi
     lp += jnp.sum(lp_pi * mask[:, None] * lmask[None, :])
 
     phi = _phi(c, num_loci)
     omega = gc_rate(c["betas"], batch.gamma_feats)               # :632-633
 
-    def bin_ll(reads, u, omega_, pi_, phi_, cn_obs, rep_obs, etas_):
+    def bin_ll(reads, u, omega_, pi_, phi_, cn_obs, rep_obs, etas_,
+               eidx_, ew_):
         if spec.step1:
             return _observed_bin_loglik(spec, reads, u, omega_, pi_, phi_,
                                         cn_obs, rep_obs, lamb, log_lamb,
                                         log1m_lamb)
+        if fused and sparse:
+            return _enum_bin_loglik_fused_sparse(
+                spec, reads, u, omega_, pi_, phi_, eidx_, ew_, lamb,
+                mesh=mesh)
         if fused:
             return _enum_bin_loglik_fused(spec, reads, u, omega_, pi_, phi_,
                                           etas_, lamb, mesh=mesh)
@@ -512,7 +595,8 @@ def log_joint(spec: PertModelSpec, params: dict, fixed: dict,
 
     if spec.cell_chunk is None:
         ll = bin_ll(batch.reads, c["u"], omega, pi_like, phi,
-                    batch.cn_obs, batch.rep_obs, etas_sm if fused else None)
+                    batch.cn_obs, batch.rep_obs, etas_sm if fused else None,
+                    eta_idx if fused else None, eta_w if fused else None)
         lp += jnp.sum(ll * mask[:, None] * lmask[None, :])
     else:
         # chunk the cells axis through lax.map so only a
@@ -537,12 +621,16 @@ def log_joint(spec: PertModelSpec, params: dict, fixed: dict,
         pi_chunked = _r_sm(pi_like) if fused else _r(pi_like)
         chunks = (_r(batch.reads), _r(c["u"]), _r(omega), pi_chunked,
                   _r(phi), _r(batch.cn_obs), _r(batch.rep_obs), _r(mask),
-                  _r_sm(etas_sm) if fused else None)
+                  _r_sm(etas_sm) if fused else None,
+                  _r(eta_idx) if fused else None,
+                  _r(eta_w) if fused else None)
 
         def body(args):
-            reads, u, omega_, pi_, phi_, cn_obs, rep_obs, m, etas_ = args
+            (reads, u, omega_, pi_, phi_, cn_obs, rep_obs, m, etas_,
+             eidx_, ew_) = args
             return jnp.sum(bin_ll(reads, u, omega_, pi_, phi_, cn_obs,
-                                  rep_obs, etas_) * m[:, None] * lmask[None, :])
+                                  rep_obs, etas_, eidx_, ew_)
+                           * m[:, None] * lmask[None, :])
 
         present = [x for x in chunks if x is not None]
         idxs = [i for i, x in enumerate(chunks) if x is not None]

@@ -25,6 +25,44 @@ def one_hot_states(states: np.ndarray, P: int) -> np.ndarray:
     return np.eye(P, dtype=np.float32)[s]
 
 
+def sparsify_etas(etas: np.ndarray) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Detect the one-hot Dirichlet structure and compact it.
+
+    Every prior built from states (hmmcopy / diploid / g1_cells /
+    g1_clones, reference: pert_model.py:272-296) — and the uniform
+    fallback — has at most ONE non-unit concentration per bin:
+    ``etas[c, l, :] = 1`` except ``etas[c, l, idx] = 1 + w``.  Returns
+    ``(eta_idx, eta_w)`` float32 (cells, loci) planes encoding exactly
+    that (``w = 0`` for uniform bins), or None when the structure does
+    not hold (the composite prior spreads weight over J+1 states — keep
+    the dense tensor then).  The compact form is what the fused TPU
+    kernel streams per iteration (ops/enum_kernel.enum_loglik_fused_sparse).
+    """
+    if etas.ndim != 3:
+        return None
+    nonunit = etas != 1.0
+    if (etas < 1.0).any() or (nonunit.sum(axis=-1) > 1).any():
+        return None
+    idx = np.argmax(etas, axis=-1)
+    w = np.take_along_axis(etas, idx[..., None], axis=-1)[..., 0] - 1.0
+    return idx.astype(np.float32), w.astype(np.float32)
+
+
+def eta_batch_fields(etas: np.ndarray, allow_sparse: bool = True) -> dict:
+    """PertBatch kwargs for a CN prior: ``{eta_idx, eta_w}`` (device
+    arrays) when the prior sparsifies and ``allow_sparse``, else
+    ``{etas}``.  Shared by the runner, the bench and the dryrun so the
+    encoding decision lives in one place; pair with
+    ``PertModelSpec(sparse_etas="eta_idx" in fields)``."""
+    import jax.numpy as jnp
+
+    if allow_sparse:
+        sp = sparsify_etas(np.asarray(etas))
+        if sp is not None:
+            return {"eta_idx": jnp.asarray(sp[0]), "eta_w": jnp.asarray(sp[1])}
+    return {"etas": jnp.asarray(etas)}
+
+
 def cn_prior_from_states(states: np.ndarray, P: int, weight: float) -> np.ndarray:
     """etas = ones, with ``weight`` at each bin's given state.
 

@@ -338,8 +338,16 @@ def _logZ(pi_ref, P, like):
     return m + jnp.log(z)
 
 
-def _fused_fwd_kernel(scal_ref, reads_ref, mu_ref, phi_ref, pi_ref, etas_ref,
-                      out_ref, lse_ref, *, P):
+def _fused_fwd_kernel(scal_ref, reads_ref, mu_ref, phi_ref, pi_ref, *rest,
+                      P, sparse):
+    """Fused forward.  ``sparse`` selects the Dirichlet-term encoding:
+    dense reads a (P, tc, tl) etas tile; sparse reads (tc, tl) tiles
+    eidx (the one non-unit state per bin) and ew (its concentration - 1)
+    — 2 planes of HBM traffic instead of P."""
+    if sparse:
+        eidx_ref, ew_ref, out_ref, lse_ref = rest
+    else:
+        etas_ref, out_ref, lse_ref = rest
     log_lamb = scal_ref[0, 0]
     log1m_lamb = scal_ref[0, 1]
     q = scal_ref[0, 2]
@@ -350,14 +358,20 @@ def _fused_fwd_kernel(scal_ref, reads_ref, mu_ref, phi_ref, pi_ref, etas_ref,
     bern0 = jnp.log1p(-phi)
     bern1 = jnp.log(phi)
     logZ = _logZ(pi_ref, P, x)
+    if sparse:
+        eidx = eidx_ref[...]
+        ew = ew_ref[...]
 
     neg_inf = jnp.full_like(x, -jnp.inf)
 
     def body(s, carry):
         m, acc, lp_acc = carry
         lp = pi_ref[s] - logZ
-        lp_acc = lp_acc + (etas_ref[s] - 1.0) * lp
         chi = s.astype(jnp.float32)
+        if sparse:
+            lp_acc = lp_acc + jnp.where(eidx == chi, ew, 0.0) * lp
+        else:
+            lp_acc = lp_acc + (etas_ref[s] - 1.0) * lp
         for bern, mult in ((bern0, 1.0), (bern1, 2.0)):
             nb, _ = _nb_core(x, mu, chi * mult, q, log1m_lamb)
             j = lp + bern + nb
@@ -373,8 +387,13 @@ def _fused_fwd_kernel(scal_ref, reads_ref, mu_ref, phi_ref, pi_ref, etas_ref,
     out_ref[...] = (lse + x * log_lamb - _lgamma_ge1(x + 1.0) + lp_acc)
 
 
-def _fused_bwd_kernel(scal_ref, reads_ref, mu_ref, phi_ref, pi_ref, etas_ref,
-                      lse_ref, g_ref, dmu_ref, dphi_ref, dpi_ref, *, P):
+def _fused_bwd_kernel(scal_ref, reads_ref, mu_ref, phi_ref, pi_ref, *rest,
+                      P, sparse):
+    if sparse:
+        (eidx_ref, ew_ref, lse_ref, g_ref,
+         dmu_ref, dphi_ref, dpi_ref) = rest
+    else:
+        etas_ref, lse_ref, g_ref, dmu_ref, dphi_ref, dpi_ref = rest
     log1m_lamb = scal_ref[0, 1]
     q = scal_ref[0, 2]
 
@@ -388,13 +407,19 @@ def _fused_bwd_kernel(scal_ref, reads_ref, mu_ref, phi_ref, pi_ref, etas_ref,
     inv_phi = 1.0 / phi
     inv_1m_phi = 1.0 / (1.0 - phi)
     logZ = _logZ(pi_ref, P, x)
+    if sparse:
+        eidx = eidx_ref[...]
+        gew = g * ew_ref[...]
 
     def body(s, carry):
         dmu, dphi, tot = carry
         lp = pi_ref[s] - logZ
         chi = s.astype(jnp.float32)
         # dL/dlog_pi_s: posterior weight of state s plus the Dirichlet term
-        dlp = g * (etas_ref[s] - 1.0)
+        if sparse:
+            dlp = jnp.where(eidx == chi, gew, 0.0)
+        else:
+            dlp = g * (etas_ref[s] - 1.0)
         for bern, dbern, mult in ((bern0, -inv_1m_phi, 1.0),
                                   (bern1, inv_phi, 2.0)):
             chi_r = chi * mult
@@ -478,7 +503,7 @@ def _fused_fwd(reads, mu, pi_logits_t, phi, etas_t, lamb, interpret):
 
     lay, grid = _grid_specs(P, nc, nl)
     out, lse = pl.pallas_call(
-        functools.partial(_fused_fwd_kernel, P=P),
+        functools.partial(_fused_fwd_kernel, P=P, sparse=False),
         grid=grid,
         in_specs=[lay["scal"], lay["cl"], lay["cl"], lay["cl"], lay["pcl"],
                   lay["pcl"]],
@@ -503,7 +528,7 @@ def _fused_bwd(interpret, res, g):
 
     lay, grid = _grid_specs(P, nc, nl)
     dmu, dphi, dpi_t = pl.pallas_call(
-        functools.partial(_fused_bwd_kernel, P=P),
+        functools.partial(_fused_bwd_kernel, P=P, sparse=False),
         grid=grid,
         in_specs=[lay["scal"], lay["cl"], lay["cl"], lay["cl"], lay["pcl"],
                   lay["pcl"], lay["cl"], lay["cl"]],
@@ -526,3 +551,120 @@ def _fused_bwd(interpret, res, g):
 enum_loglik_fused.defvjp(
     lambda r, m, pi, p, e, la, i: _fused_fwd(r, m, pi, p, e, la, i),
     _fused_bwd)
+
+
+# ---------------------------------------------------------------------------
+# sparse-etas variant: one non-unit Dirichlet state per bin
+# ---------------------------------------------------------------------------
+#
+# Every production CN-prior method except the composite one concentrates
+# the Dirichlet on a SINGLE state per bin:
+# etas[c, l, s] = 1 + (s == idx[c, l]) * w[c, l]
+# (reference: pert_model.py:299-361 builds exactly this from hmmcopy /
+# diploid / g1 states with weight cn_prior_weight=1e6).  The dense
+# (P, cells, loci) etas tensor is then ~P x the information content, and
+# reading it in BOTH kernel passes is the largest remaining per-iteration
+# HBM stream after the log_pi fusion: 2P planes of traffic that this
+# variant replaces with 4 (eidx + ew in each pass) — a ~30% cut of total
+# fused-step traffic at P=13.  The runner detects the structure host-side
+# (models/priors.sparsify_etas) and selects this kernel automatically.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7,))
+def enum_loglik_fused_sparse(reads, mu, pi_logits_t, phi, eta_idx, eta_w,
+                             lamb, interpret=False):
+    """(cells, loci) fused objective with the one-hot Dirichlet encoding:
+
+        logsumexp_{s,r} joint(s, r) + eta_w * log_softmax(pi)_{eta_idx}
+
+    ``pi_logits_t`` is STATE-MAJOR (P, cells, loci) as in
+    :func:`enum_loglik_fused`; ``eta_idx``/``eta_w`` are (cells, loci)
+    float32 — the index of the bin's non-unit state and its concentration
+    minus one (w = 0 encodes a uniform-prior bin).  Gradient contract:
+    cotangents for ``mu``, ``pi_logits_t``, ``phi``; silent zeros for the
+    rest.
+    """
+    out, _ = _fused_sparse_fwd(reads, mu, pi_logits_t, phi, eta_idx, eta_w,
+                               lamb, interpret)
+    return out
+
+
+def _prep_fused_sparse(reads, mu, pi_logits_t, phi, eta_idx, eta_w, lamb):
+    # pad values: eidx = -1 matches no state, ew = 0 — padded bins add 0
+    scal = _scalars(lamb)
+    return (scal,
+            _pad2(reads, TILE_C, TILE_L, 0.0),
+            _pad2(mu, TILE_C, TILE_L, 1.0),
+            _pad2(phi, TILE_C, TILE_L, 0.5),
+            _pad2(pi_logits_t, TILE_C, TILE_L, 0.0),
+            _pad2(eta_idx, TILE_C, TILE_L, -1.0),
+            _pad2(eta_w, TILE_C, TILE_L, 0.0))
+
+
+def _fused_sparse_fwd(reads, mu, pi_logits_t, phi, eta_idx, eta_w, lamb,
+                      interpret):
+    C, L = reads.shape
+    if pi_logits_t.ndim != 3 or pi_logits_t.shape[1:] != reads.shape \
+            or eta_idx.shape != reads.shape or eta_w.shape != reads.shape:
+        raise ValueError(
+            "enum_loglik_fused_sparse expects STATE-MAJOR pi_logits_t of "
+            f"shape ('P',) + {reads.shape} and (cells, loci) eta_idx/eta_w; "
+            f"got pi_logits_t {pi_logits_t.shape}, eta_idx {eta_idx.shape}, "
+            f"eta_w {eta_w.shape}")
+    P = pi_logits_t.shape[0]
+    scal, reads_p, mu_p, phi_p, pi_p, eidx_p, ew_p = _prep_fused_sparse(
+        reads, mu, pi_logits_t, phi, eta_idx, eta_w, lamb)
+    nc, nl = reads_p.shape
+
+    lay, grid = _grid_specs(P, nc, nl)
+    out, lse = pl.pallas_call(
+        functools.partial(_fused_fwd_kernel, P=P, sparse=True),
+        grid=grid,
+        in_specs=[lay["scal"], lay["cl"], lay["cl"], lay["cl"], lay["pcl"],
+                  lay["cl"], lay["cl"]],
+        out_specs=[lay["cl"], lay["cl"]],
+        out_shape=[jax.ShapeDtypeStruct((nc, nl), jnp.float32),
+                   jax.ShapeDtypeStruct((nc, nl), jnp.float32)],
+        interpret=interpret,
+    )(scal, reads_p, mu_p, phi_p, pi_p, eidx_p, ew_p)
+    return out[:C, :L], (reads, mu, pi_logits_t, phi, eta_idx, eta_w, lamb,
+                         lse[:C, :L])
+
+
+def _fused_sparse_bwd(interpret, res, g):
+    reads, mu, pi_logits_t, phi, eta_idx, eta_w, lamb, lse = res
+    C, L = reads.shape
+    P = pi_logits_t.shape[0]
+    scal, reads_p, mu_p, phi_p, pi_p, eidx_p, ew_p = _prep_fused_sparse(
+        reads, mu, pi_logits_t, phi, eta_idx, eta_w, lamb)
+    lse_p = _pad2(lse, TILE_C, TILE_L, 0.0)
+    g_p = _pad2(g, TILE_C, TILE_L, 0.0)
+    nc, nl = reads_p.shape
+
+    lay, grid = _grid_specs(P, nc, nl)
+    dmu, dphi, dpi_t = pl.pallas_call(
+        functools.partial(_fused_bwd_kernel, P=P, sparse=True),
+        grid=grid,
+        in_specs=[lay["scal"], lay["cl"], lay["cl"], lay["cl"], lay["pcl"],
+                  lay["cl"], lay["cl"], lay["cl"], lay["cl"]],
+        out_specs=[lay["cl"], lay["cl"], lay["pcl"]],
+        out_shape=[
+            jax.ShapeDtypeStruct((nc, nl), jnp.float32),
+            jax.ShapeDtypeStruct((nc, nl), jnp.float32),
+            jax.ShapeDtypeStruct((P, nc, nl), jnp.float32),
+        ],
+        interpret=interpret,
+    )(scal, reads_p, mu_p, phi_p, pi_p, eidx_p, ew_p, lse_p, g_p)
+
+    dmu = dmu[:C, :L]
+    dphi = dphi[:C, :L]
+    dpi_t = dpi_t[:, :C, :L]
+    return (jnp.zeros_like(reads), dmu, dpi_t, dphi,
+            jnp.zeros_like(eta_idx), jnp.zeros_like(eta_w),
+            jnp.zeros_like(jnp.asarray(lamb)))
+
+
+enum_loglik_fused_sparse.defvjp(
+    lambda r, m, pi, p, ei, ew, la, i: _fused_sparse_fwd(
+        r, m, pi, p, ei, ew, la, i),
+    _fused_sparse_bwd)

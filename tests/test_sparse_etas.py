@@ -1,0 +1,201 @@
+"""Parity tests for the sparse one-hot Dirichlet-prior path.
+
+The production CN priors (hmmcopy / diploid / g1_cells / g1_clones,
+reference: pert_model.py:272-296) concentrate on ONE state per bin, so
+the dense (cells, loci, P) etas tensor is ~P x its information content.
+``priors.sparsify_etas`` compacts it to (eta_idx, eta_w) planes and the
+fused kernel streams those instead (ops/enum_kernel.py).  These tests pin
+that the sparse encoding computes the IDENTICAL objective and gradients
+as the dense path at every level: kernel, full model loss, and the
+runner's end-to-end fit.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scdna_replication_tools_tpu.layout import state_major
+from scdna_replication_tools_tpu.models.pert import (
+    PertBatch,
+    PertModelSpec,
+    init_params,
+    pert_loss,
+)
+from scdna_replication_tools_tpu.models.priors import sparsify_etas
+from scdna_replication_tools_tpu.ops.enum_kernel import (
+    enum_loglik_fused,
+    enum_loglik_fused_sparse,
+)
+from scdna_replication_tools_tpu.ops.gc import gc_features
+
+P = 13
+
+
+def test_sparsify_etas_detects_one_hot():
+    rng = np.random.default_rng(0)
+    etas = np.ones((4, 10, P), np.float32)
+    states = rng.integers(0, P, (4, 10))
+    np.put_along_axis(etas, states[..., None], 1e6 + 1.0, axis=-1)
+    # a few uniform bins (all ones) must also be representable
+    etas[0, :3, :] = 1.0
+    sp = sparsify_etas(etas)
+    assert sp is not None
+    idx, w = sp
+    assert idx.shape == w.shape == (4, 10)
+    assert np.all(w[0, :3] == 0.0)
+    np.testing.assert_array_equal(idx[1:], states[1:])
+    np.testing.assert_allclose(w[1:], 1e6, rtol=1e-6)
+
+
+def test_sparsify_etas_rejects_multi_state_and_sub_unit():
+    etas = np.ones((2, 5, P), np.float32)
+    etas[..., 2] = 50.0
+    etas[..., 3] = 50.0   # composite-style two-state bin
+    assert sparsify_etas(etas) is None
+    etas = np.ones((2, 5, P), np.float32)
+    etas[..., 2] = 0.5    # sub-unit concentration
+    assert sparsify_etas(etas) is None
+
+
+def _problem(C=8, L=96, seed=7, weight=1e5):
+    rng = np.random.default_rng(seed)
+    reads = jnp.asarray(rng.poisson(40, (C, L)).astype(np.float32))
+    mu = jnp.asarray(rng.uniform(2, 30, (C, L)).astype(np.float32))
+    logits = jnp.asarray(rng.normal(0, 2, (C, L, P)).astype(np.float32))
+    phi = jnp.asarray(rng.uniform(0.01, 0.99, (C, L)).astype(np.float32))
+    etas = np.ones((C, L, P), np.float32)
+    states = rng.integers(0, P, (C, L))
+    np.put_along_axis(etas, states[..., None], weight, axis=-1)
+    idx, w = sparsify_etas(etas)
+    return (reads, mu, logits, phi, jnp.asarray(etas),
+            jnp.asarray(idx), jnp.asarray(w), jnp.float32(0.75))
+
+
+def test_sparse_kernel_matches_dense_kernel():
+    """enum_loglik_fused_sparse must equal enum_loglik_fused (value AND
+    all gradients) on a one-hot prior — same math, compact encoding."""
+    reads, mu, logits, phi, etas, idx, w, lamb = _problem()
+    rng = np.random.default_rng(3)
+    ct = jnp.asarray(rng.normal(0, 1, reads.shape), jnp.float32)
+
+    def dense(mu, logits, phi):
+        return jnp.sum(enum_loglik_fused(
+            reads, mu, state_major(logits), phi, state_major(etas), lamb,
+            True) * ct)
+
+    def sparse(mu, logits, phi):
+        return jnp.sum(enum_loglik_fused_sparse(
+            reads, mu, state_major(logits), phi, idx, w, lamb, True) * ct)
+
+    vd, gd = jax.value_and_grad(dense, (0, 1, 2))(mu, logits, phi)
+    vs, gs = jax.value_and_grad(sparse, (0, 1, 2))(mu, logits, phi)
+    assert abs(float(vd - vs)) / abs(float(vd)) < 1e-5
+    for name, a, b in zip(("dmu", "dpi", "dphi"), gd, gs):
+        rel = jnp.max(jnp.abs(a - b)) / (jnp.max(jnp.abs(a)) + 1e-30)
+        assert float(rel) < 1e-4, (name, float(rel))
+
+
+def test_sparse_kernel_rejects_bad_shapes():
+    reads, mu, logits, phi, etas, idx, w, lamb = _problem()
+    with pytest.raises(ValueError, match="STATE-MAJOR"):
+        enum_loglik_fused_sparse(reads, mu, logits, phi, idx, w, lamb, True)
+
+
+def _model_problem(weight):
+    rng = np.random.default_rng(5)
+    C, L = 12, 200
+    reads = rng.poisson(40, (C, L)).astype(np.float32)
+    gammas = rng.uniform(0.35, 0.6, L).astype(np.float32)
+    etas = np.ones((C, L, P), np.float32)
+    states = rng.integers(1, 5, (C, L))
+    np.put_along_axis(etas, states[..., None], weight, axis=-1)
+    idx, w = sparsify_etas(etas)
+    common = dict(
+        reads=jnp.asarray(reads), libs=jnp.zeros((C,), jnp.int32),
+        gamma_feats=gc_features(jnp.asarray(gammas), 4),
+        mask=jnp.ones((C,), jnp.float32))
+    dense_batch = PertBatch(etas=jnp.asarray(etas), **common)
+    sparse_batch = PertBatch(eta_idx=jnp.asarray(idx),
+                             eta_w=jnp.asarray(w), **common)
+    fixed = {"beta_means": jnp.zeros((1, 5), jnp.float32),
+             "lamb": jnp.asarray(0.75, jnp.float32)}
+    t_init = np.full(C, 0.4, np.float32)
+    return dense_batch, sparse_batch, fixed, t_init
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas_interpret"])
+def test_pert_loss_sparse_matches_dense(impl):
+    """Full model loss + gradients: sparse_etas encoding vs dense etas.
+
+    weight=1e3 keeps the dense path's float32 gammaln normaliser accurate
+    enough for a tight value comparison (at 1e6 the DENSE path carries
+    ~1-per-bin f32 cancellation noise in the parameter-free constant —
+    the sparse analytic form is the more accurate of the two)."""
+    dense_batch, sparse_batch, fixed, t_init = _model_problem(weight=1e3)
+
+    out = {}
+    for name, batch, sparse in (("dense", dense_batch, False),
+                                ("sparse", sparse_batch, True)):
+        spec = PertModelSpec(P=P, K=4, L=1, tau_mode="param",
+                             cond_beta_means=True, fixed_lamb=True,
+                             sparse_etas=sparse, enum_impl=impl)
+        params = init_params(spec, batch, fixed, t_init=t_init)
+        out[name] = jax.value_and_grad(
+            lambda p: pert_loss(spec, p, fixed, batch))(params)
+
+    (vd, gd), (vs, gs) = out["dense"], out["sparse"]
+    assert abs(float(vd - vs)) / abs(float(vd)) < 1e-5, (float(vd), float(vs))
+    for k in gd:
+        denom = float(jnp.max(jnp.abs(gd[k]))) + 1e-20
+        rel = float(jnp.max(jnp.abs(gd[k] - gs[k]))) / denom
+        assert rel < 2e-2, (k, rel)
+
+
+def test_init_params_sparse_matches_dense():
+    dense_batch, sparse_batch, fixed, t_init = _model_problem(weight=1e3)
+    spec_d = PertModelSpec(P=P, K=4, L=1, cond_beta_means=True,
+                           fixed_lamb=True)
+    spec_s = PertModelSpec(P=P, K=4, L=1, cond_beta_means=True,
+                           fixed_lamb=True, sparse_etas=True)
+    pd_ = init_params(spec_d, dense_batch, fixed, t_init=t_init)
+    ps_ = init_params(spec_s, sparse_batch, fixed, t_init=t_init)
+    # identical pi init (up to float op order) and identical u init
+    # (same ploidy guess from the compact encoding)
+    np.testing.assert_allclose(np.asarray(pd_["pi_logits"]),
+                               np.asarray(ps_["pi_logits"]), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(pd_["u"]), np.asarray(ps_["u"]),
+                               rtol=1e-6)
+
+
+def test_runner_auto_sparse_matches_dense_fit(synthetic_frames):
+    """End-to-end: the runner's auto-detected sparse path must reproduce
+    the dense fit's loss trajectory (g1_clones is one-hot structured).
+    cn_prior_weight=1e6 here — the parameter-free Dirichlet constant
+    differs between the two encodings by dense-path f32 gammaln noise,
+    so trajectories are compared after subtracting the iteration-0
+    offset (gradients, and hence the fit, are identical)."""
+    from conftest import dense_inputs_from_frames
+    from scdna_replication_tools_tpu.config import PertConfig
+    from scdna_replication_tools_tpu.infer.runner import PertInference
+
+    s, g1, clone_idx = dense_inputs_from_frames(synthetic_frames)
+
+    def run(sparse):
+        config = PertConfig(cn_prior_method="g1_clones", max_iter=25,
+                            min_iter=12, run_step3=False,
+                            sparse_etas=sparse,
+                            enum_impl="pallas_interpret")
+        inf = PertInference(s, g1, config, clone_idx_s=clone_idx,
+                            clone_idx_g1=clone_idx, num_clones=2)
+        _, step2, _ = inf.run()
+        assert step2.spec.sparse_etas == sparse
+        return np.asarray(step2.fit.losses, np.float64)
+
+    dense = run(False)
+    sparse = run(True)
+    assert sparse.shape == dense.shape
+    # constant offset = the differently-computed Dirichlet normaliser
+    np.testing.assert_allclose(sparse - sparse[0], dense - dense[0],
+                               rtol=5e-4, atol=2.0)
