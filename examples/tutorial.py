@@ -125,6 +125,28 @@ def main(argv=None):
     fig_path = os.path.join(args.outdir, "model_results.png")
     fig.savefig(fig_path, dpi=120, bbox_inches="tight")
 
+    # loss curves (inference_tutorial.ipynb cells 10-11): one panel per
+    # SVI step, from the supplementary tables' loss_g / loss_s records
+    import matplotlib.pyplot as plt
+
+    fig2, axes = plt.subplots(1, 2, figsize=(9, 3.2))
+    for ax, supp, title in ((axes[0], supp_s, "S cells (steps 1+2)"),
+                            (axes[1], supp_g1, "G1/2 cells (step 3)")):
+        if supp is None or not len(supp):
+            ax.set_axis_off()
+            continue
+        for param, style in (("loss_g", "C0-"), ("loss_s", "C1-")):
+            curve = supp.query("param == @param")["value"].to_numpy()
+            if len(curve):
+                ax.plot(curve, style, label=param)
+        ax.set_xlabel("iteration")
+        ax.set_ylabel("-ELBO loss")
+        ax.set_title(title)
+        ax.legend()
+    fig2.tight_layout()
+    loss_path = os.path.join(args.outdir, "loss_curves.png")
+    fig2.savefig(loss_path, dpi=120, bbox_inches="tight")
+
     for name, frame in (("cn_s_out", cn_s_out), ("cn_g1_out", cn_g1_out),
                         ("supp_s", supp_s), ("cn_phase", cn_phase),
                         ("pseudobulk", bulk)):
