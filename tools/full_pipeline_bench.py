@@ -103,6 +103,7 @@ def run(args):
 
     t0 = time.perf_counter()
     df_s, df_g, truth_s = make_genome_workload(args.cells, args.g1_cells,
+                                               bin_size=args.bin_size,
                                                seed=args.seed)
     t_data = time.perf_counter() - t0
     num_loci = df_s.groupby(["chr", "start"]).ngroups
@@ -110,7 +111,8 @@ def run(args):
     scrt = scRT(df_s, df_g, input_col="reads", clone_col="clone_id",
                 assign_col="copy", cn_prior_method=args.cn_prior_method,
                 max_iter=args.max_iter, min_iter=args.min_iter,
-                run_step3=args.run_step3, enum_impl=args.enum_impl)
+                run_step3=args.run_step3, enum_impl=args.enum_impl,
+                num_shards=args.num_shards, loci_shards=args.loci_shards)
     if args.profile_dir:
         import dataclasses
         scrt.config = dataclasses.replace(scrt.config,
@@ -151,6 +153,9 @@ def run(args):
         "step3_iters": int(len(loss_g)),
         "tau_truth_correlation": round(tau_corr, 4),
         "run_step3": bool(args.run_step3),
+        "bin_size": args.bin_size,
+        "num_shards": args.num_shards,
+        "loci_shards": args.loci_shards,
         "profile_dir": args.profile_dir,
     }
     print(json.dumps(out))
@@ -160,11 +165,33 @@ def run(args):
     return out
 
 
+def _ensure_devices(n):
+    """A CPU host has one device; a sharded run needs n virtual ones.
+    Must land before the backend initialises (jax may already be
+    imported by sitecustomize — the env var still works until the first
+    device access).  Host-platform-only flag: harmless on TPU."""
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--cells", type=int, default=1000,
                     help="S-phase cells (BASELINE.md config 3 scale)")
     ap.add_argument("--g1-cells", type=int, default=250)
+    ap.add_argument("--bin-size", type=int, default=500_000,
+                    help="genome bin size; 20000 reproduces the "
+                         "reference's long-genome pain point "
+                         "(154,770 loci over the hg19 autosome table, "
+                         "README.md:55-57)")
+    ap.add_argument("--num-shards", type=int, default=1)
+    ap.add_argument("--loci-shards", type=int, default=1,
+                    help="2-D (cells x loci) mesh for the long-genome "
+                         "regime; total devices = num_shards * loci_shards")
     ap.add_argument("--max-iter", type=int, default=800)
     ap.add_argument("--min-iter", type=int, default=100)
     ap.add_argument("--cn-prior-method", default="g1_clones")
@@ -174,6 +201,9 @@ def main(argv=None):
     ap.add_argument("--profile-dir", default=None)
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
+    needed = args.num_shards * args.loci_shards
+    if needed > 1:
+        _ensure_devices(needed)
     run(args)
 
 
