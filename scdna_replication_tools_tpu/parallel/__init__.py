@@ -3,5 +3,14 @@ from scdna_replication_tools_tpu.parallel.mesh import (
     shard_batch,
     shard_params,
 )
+from scdna_replication_tools_tpu.parallel.distributed import (
+    HostShard,
+    global_mesh,
+    init_distributed,
+    shard_batch_multihost,
+    shard_params_multihost,
+)
 
-__all__ = ["make_mesh", "shard_batch", "shard_params"]
+__all__ = ["make_mesh", "shard_batch", "shard_params", "HostShard",
+           "global_mesh", "init_distributed", "shard_batch_multihost",
+           "shard_params_multihost"]

@@ -1,0 +1,172 @@
+"""Multi-host execution: jax.distributed init + per-host data feeding.
+
+The reference is single-process (its only device notion is a ``cuda``
+bool, reference: pert_model.py:70, 101, 649-651).  The single-host mesh
+path (``parallel.mesh``) already scales across the chips of one host;
+this module adds the multi-host story the way JAX means it to be done —
+no NCCL/MPI translation, no explicit collectives:
+
+1. every host calls :func:`init_distributed` once at startup (the
+   JAX service handshake over DCN; on Cloud TPU pods the coordinator /
+   process count / index are inferred from the environment);
+2. :func:`global_mesh` builds the mesh over ``jax.devices()`` — which
+   after init enumerates EVERY chip in the slice/pod, not just the
+   local host's — using the same axis names and layout contract
+   (``layout.py``) as the single-host path, so the model code is
+   untouched: the compiled program is identical SPMD, XLA routes the
+   gradient all-reduces over ICI within a host and DCN across hosts;
+3. :func:`shard_batch_multihost` / :func:`shard_params_multihost` place
+   HOST-LOCAL numpy shards into global jax.Arrays via
+   ``jax.make_array_from_process_local_data`` — each host pivots and
+   feeds only its own cells (the loader never materialises the global
+   matrix anywhere), which is what makes 100k-cell runs feasible.
+
+Single-process is the degenerate case throughout (process_count == 1:
+init is a no-op, the local data IS the global data), so the whole module
+is exercised by the test suite without a pod.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from scdna_replication_tools_tpu import layout
+from scdna_replication_tools_tpu.models.pert import PertBatch
+from scdna_replication_tools_tpu.parallel.mesh import loci_axis, make_mesh
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     auto: bool = False) -> int:
+    """Initialise the JAX distributed service; returns process_count.
+
+    On Cloud TPU pods call ``init_distributed(auto=True)`` — the
+    coordinator / process count / rank are then inferred from the TPU
+    metadata environment by ``jax.distributed.initialize()``.  Elsewhere
+    pass the coordinator's ``host:port`` plus this process's rank.  With
+    no arguments this is an explicit single-process no-op (``auto`` is
+    required for env-inferred pod init so that a mis-deployed pod run
+    cannot silently degrade into per-host independent models).
+    Idempotent: a second call is a no-op.
+    """
+    if jax.process_count() > 1:
+        return jax.process_count()  # already initialised
+    if not auto and coordinator_address is None \
+            and num_processes in (None, 1):
+        return 1  # single-process: nothing to do
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    return jax.process_count()
+
+
+def global_mesh(cell_shards: Optional[int] = None,
+                loci_shards: int = 1) -> Mesh:
+    """Mesh over every device of every host (after init_distributed).
+
+    Identical axis names / layout contract as the single-host mesh —
+    ``make_mesh`` already builds from ``jax.devices()``, which is the
+    global device list in a distributed runtime.
+    """
+    return make_mesh(cell_shards, loci_shards=loci_shards)
+
+
+@dataclasses.dataclass(frozen=True)
+class HostShard:
+    """This host's slice of the global cells axis.
+
+    ``lo:hi`` indexes the GLOBAL cell axis; the host loads/pivots only
+    those cells.  Cells are distributed contiguously and EVENLY — host k
+    of n owns ``k*(C/n) : (k+1)*(C/n)`` — because
+    ``make_array_from_process_local_data`` needs every host's slice to
+    match its addressable shard; pad the global count to a multiple of
+    the total cell-shard count first (``data.loader.pad_cells``).
+    """
+
+    num_global_cells: int
+    lo: int
+    hi: int
+
+    @classmethod
+    def for_this_process(cls, num_global_cells: int) -> "HostShard":
+        n = jax.process_count()
+        k = jax.process_index()
+        if num_global_cells % n:
+            raise ValueError(
+                f"global cell count {num_global_cells} must divide evenly "
+                f"over {n} hosts — pad with data.loader.pad_cells first")
+        per = num_global_cells // n
+        return cls(num_global_cells, k * per, (k + 1) * per)
+
+
+def _cells_axis_index(spec) -> Optional[int]:
+    """Index of the cells axis in a PartitionSpec, or None."""
+    for i, entry in enumerate(spec):
+        names = entry if isinstance(entry, tuple) else (entry,)
+        if layout.CELLS_AXIS in names:
+            return i
+    return None
+
+
+def _place(mesh: Mesh, local, spec, num_global_cells: int):
+    """Assemble one global jax.Array from this host's local data.
+
+    The global shape is derived from the PartitionSpec alone: the axis
+    carrying ``layout.CELLS_AXIS`` scales from the host-local slice to
+    the global cell count; every other field (loci-axis profiles,
+    replicated globals) is identical on all hosts, and — because hosts
+    tile the mesh along the cells axis — this host's addressable shard
+    of such an array is exactly the full local array, which is what
+    ``make_array_from_process_local_data`` expects.
+    """
+    if local is None:
+        return None
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(local, sharding)
+    arr = np.asarray(local)
+    gshape = list(arr.shape)
+    axis = _cells_axis_index(spec)
+    if axis is not None:
+        gshape[axis] = num_global_cells
+    return jax.make_array_from_process_local_data(
+        sharding, arr, tuple(gshape))
+
+
+def shard_batch_multihost(mesh: Mesh, local_batch: PertBatch,
+                          shard: HostShard) -> PertBatch:
+    """Assemble the global PertBatch from per-host cell slices.
+
+    ``local_batch`` holds THIS host's cells only (numpy or device
+    arrays); fields without a cells axis in their spec (gamma_feats,
+    loci_mask) must be identical on every host.  Which axis is the
+    cells axis comes from ``layout.batch_specs`` — adding a field to
+    the layout automatically routes it correctly here.
+    """
+    specs = layout.batch_specs(loci_axis(mesh))
+    return PertBatch(**{
+        name: _place(mesh, getattr(local_batch, name), spec,
+                     shard.num_global_cells)
+        for name, spec in specs.items()
+    })
+
+
+def shard_params_multihost(mesh: Mesh, local_params: dict,
+                           shard: HostShard) -> dict:
+    """Assemble the global parameter pytree from per-host slices.
+
+    Per-cell parameters (tau/u/betas and the state-major pi_logits —
+    whose cells axis is axis 1, read off its PartitionSpec) are
+    host-local slices; global parameters must be identical on every
+    host and place replicated.
+    """
+    specs = layout.param_specs(loci_axis(mesh))
+    return {name: _place(mesh, val, specs[name], shard.num_global_cells)
+            for name, val in local_params.items()}

@@ -10,8 +10,8 @@ story is a 1-D or 2-D ``jax.sharding.Mesh``:
   (cells, loci, P) pi tensor) shard cleanly along 'cells' — FSDP-like:
   each device owns its cells' parameter slices outright, no gathering;
 * **loci** is the optional second axis for the long-genome regime (20kb
-  bins: ~136k loci — the reference README warns this is runtime/NaN
-  territory, README.md:55-57).  The likelihood has no cross-locus
+  bins: ~155k loci over the hg19 autosome table — the reference README
+  warns this is runtime/NaN territory, README.md:55-57).  The likelihood has no cross-locus
   coupling, so reads/etas/pi shard over ('cells', 'loci') tiles and the
   per-locus rho shards over 'loci'.  Only the per-cell reductions (u
   prior's masked read-mean, the final loss sum) cross loci — XLA turns

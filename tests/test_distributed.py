@@ -1,0 +1,53 @@
+"""Multi-host plumbing (parallel/distributed.py), single-process case.
+
+A real pod cannot run in CI; what CAN be pinned is that the multi-host
+entry points are exact aliases of the single-host path when
+process_count == 1 (the degenerate case the module documents), so model
+code driven through them produces identical results — the multi-host
+path then differs only in how jax.Arrays are assembled
+(make_array_from_process_local_data), which JAX owns.
+"""
+
+import numpy as np
+
+from scdna_replication_tools_tpu.infer.svi import fit_map
+from scdna_replication_tools_tpu.models.pert import pert_loss
+from scdna_replication_tools_tpu.parallel.distributed import (
+    HostShard,
+    global_mesh,
+    init_distributed,
+    shard_batch_multihost,
+    shard_params_multihost,
+)
+from scdna_replication_tools_tpu.parallel.mesh import shard_batch, shard_params
+
+from __graft_entry__ import _toy_problem
+
+
+def test_init_distributed_single_process_noop():
+    assert init_distributed() == 1
+
+
+def test_host_shard_bounds():
+    shard = HostShard.for_this_process(32)
+    assert (shard.lo, shard.hi) == (0, 32)
+
+
+def test_multihost_placement_matches_single_host_fit():
+    spec, params, fixed, batch = _toy_problem(num_cells=16, num_loci=64,
+                                              enum_impl="pallas_interpret",
+                                              sparse=True)
+    mesh = global_mesh(4, loci_shards=2)
+    shard = HostShard.for_this_process(16)
+
+    def run(b, p):
+        def loss_fn(p_, fixed_, b_):
+            return pert_loss(spec, p_, fixed_, b_, mesh=mesh)
+        fit = fit_map(loss_fn, p, (fixed, b), max_iter=4, min_iter=4,
+                      learning_rate=5e-2)
+        return np.asarray(fit.losses, np.float64)
+
+    ref = run(shard_batch(mesh, batch), shard_params(mesh, params))
+    got = run(shard_batch_multihost(mesh, batch, shard),
+              shard_params_multihost(mesh, params, shard))
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
