@@ -40,6 +40,9 @@ from scdna_replication_tools_tpu.models.pert import PertBatch
 from scdna_replication_tools_tpu.parallel.mesh import loci_axis, make_mesh
 
 
+_initialized = False
+
+
 def init_distributed(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
                      process_id: Optional[int] = None,
@@ -55,8 +58,11 @@ def init_distributed(coordinator_address: Optional[str] = None,
     cannot silently degrade into per-host independent models).
     Idempotent: a second call is a no-op.
     """
-    if jax.process_count() > 1:
-        return jax.process_count()  # already initialised
+    global _initialized
+    if _initialized or jax.process_count() > 1:
+        # already initialised (the process_count check alone would miss a
+        # 1-process slice that DID initialize — re-initialising raises)
+        return jax.process_count()
     if not auto and coordinator_address is None \
             and num_processes in (None, 1):
         return 1  # single-process: nothing to do
@@ -64,6 +70,7 @@ def init_distributed(coordinator_address: Optional[str] = None,
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id)
+    _initialized = True
     return jax.process_count()
 
 
@@ -104,6 +111,32 @@ class HostShard:
                 f"over {n} hosts — pad with data.loader.pad_cells first")
         per = num_global_cells // n
         return cls(num_global_cells, k * per, (k + 1) * per)
+
+
+def _validate_host_tiling(mesh: Mesh) -> None:
+    """Fail fast when host device blocks cannot tile whole cells-rows.
+
+    The global device enumeration is process-major, so host k's devices
+    occupy a contiguous block of the flattened (cells x loci) grid; the
+    per-host feeding below is only correct when that block covers WHOLE
+    rows of the cells axis — i.e. ``loci_shards`` divides the per-host
+    device count.  Otherwise (e.g. 4 hosts x 4 chips with
+    loci_shards=8) a host's addressable cells shard differs from its
+    ``HostShard`` slice and the failure would surface as an opaque
+    shape/sharding error deep inside
+    ``make_array_from_process_local_data``.
+    """
+    if jax.process_count() == 1:
+        return
+    lx = loci_axis(mesh)
+    ln = mesh.shape[lx] if lx is not None else 1
+    local = jax.local_device_count()
+    if local % ln != 0:
+        raise ValueError(
+            f"loci_shards={ln} does not divide this host's "
+            f"{local} devices: each host must own whole cells-rows of "
+            "the mesh for per-host data feeding — lower loci_shards or "
+            "use more chips per host")
 
 
 def _cells_axis_index(spec) -> Optional[int]:
@@ -150,6 +183,7 @@ def shard_batch_multihost(mesh: Mesh, local_batch: PertBatch,
     cells axis comes from ``layout.batch_specs`` — adding a field to
     the layout automatically routes it correctly here.
     """
+    _validate_host_tiling(mesh)
     specs = layout.batch_specs(loci_axis(mesh))
     return PertBatch(**{
         name: _place(mesh, getattr(local_batch, name), spec,
@@ -167,6 +201,7 @@ def shard_params_multihost(mesh: Mesh, local_params: dict,
     host-local slices; global parameters must be identical on every
     host and place replicated.
     """
+    _validate_host_tiling(mesh)
     specs = layout.param_specs(loci_axis(mesh))
     return {name: _place(mesh, val, specs[name], shard.num_global_cells)
             for name, val in local_params.items()}

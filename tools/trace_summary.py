@@ -1,0 +1,77 @@
+"""Summarise jax.profiler traces into a small committable text report.
+
+``PertConfig(profile_dir=...)`` / ``full_pipeline_bench.py
+--profile-dir`` write one TensorBoard/Perfetto trace per SVI-step fit;
+the raw dumps are tens of MB, so artifacts commit this summary instead
+(e.g. artifacts/PROFILE_r05_cpu_summary.txt):
+
+    python tools/trace_summary.py <profile_dir> [--top 12] [--out FILE]
+
+For each ``plugins/profile/<run>/*.trace.json.gz`` the report lists the
+top ops by total self-duration, with the profiler's own bookkeeping
+frames (wrapper/asarray/fit_map wrappers) filtered out so the XLA
+fusions the device actually ran lead the list.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+_SKIP = ("wrapper", "np.asarray", "_value", "__int__",
+         "wait for completion", "fit_map", "reraise_with_filtered",
+         "cache_miss", "_run_python_pjit", "pjit_call_impl",
+         "compile_or_get_cached", "_cached_compilation", "from_hlo",
+         "_compile_and_write_cache", "backend_compile")
+
+
+def summarise(profile_dir: str, top: int = 12) -> str:
+    lines = [f"# jax.profiler trace summary for {profile_dir}",
+             "# top ops by total self-duration per captured trace "
+             "(bookkeeping frames filtered)", ""]
+    traces = sorted(glob.glob(os.path.join(
+        profile_dir, "plugins", "profile", "*", "*.trace.json.gz")))
+    if not traces:
+        raise SystemExit(f"no *.trace.json.gz under {profile_dir}")
+    for path in traces:
+        with gzip.open(path) as fh:
+            data = json.load(fh)
+        events = [e for e in data.get("traceEvents", [])
+                  if e.get("ph") == "X"]
+        total = collections.Counter()
+        for e in events:
+            total[e.get("name", "?")] += e.get("dur", 0)
+        lines.append(f"== {path.split(os.sep)[-2]}  ({len(events)} events)")
+        shown = 0
+        for name, dur in total.most_common(200):
+            if any(s in name for s in _SKIP):
+                continue
+            lines.append(f"   {dur / 1e6:10.2f}s  {name[:100]}")
+            shown += 1
+            if shown >= top:
+                break
+        lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("profile_dir")
+    ap.add_argument("--top", type=int, default=12)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+    report = summarise(args.profile_dir, args.top)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(report + "\n")
+    else:
+        sys.stdout.write(report + "\n")
+
+
+if __name__ == "__main__":
+    main()
