@@ -168,6 +168,32 @@ class PertInference:
             return batch, params
         return shard_batch(self._mesh, batch), shard_params(self._mesh, params)
 
+    def _warn_if_enum_tensor_huge(self, spec: PertModelSpec,
+                                  batch: PertBatch) -> None:
+        """The XLA broadcast path materialises the (cells, loci, P, 2)
+        enumeration tensor (plus AD residuals of the same order); past a
+        few GB per device that is OOM territory the reference simply
+        crashes into (its README's 20kb-bin warning).  Warn with the
+        knobs that avoid it: the fused kernel never materialises the
+        tensor, cell_chunk scans it in slabs, sharding divides it."""
+        if spec.step1 or spec.enum_impl != "xla":
+            return
+        cells, loci = batch.reads.shape
+        if self._mesh is not None:
+            cells = -(-cells // self._mesh.shape[CELLS_AXIS])
+            loci = -(-loci // self._mesh.shape.get(LOCI_AXIS, 1))
+        if spec.cell_chunk:
+            # chunking bounds the live slab, not the whole tensor — the
+            # per-chunk slab can still blow the budget at high loci
+            cells = min(cells, spec.cell_chunk)
+        gb = cells * loci * spec.P * 2 * 4 / 1e9
+        if gb > 2.0:
+            profiling.logger.warning(
+                "enumeration tensor is %.1f GB per device on the XLA "
+                "path (%d cells x %d loci x %d states x 2); consider "
+                "enum_impl='pallas' (TPU), cell_chunk=..., or more "
+                "shards before this OOMs", gb, cells, loci, spec.P)
+
     def _pad(self, data: PertData) -> PertData:
         mult = 1
         loci_mult = 1
@@ -293,6 +319,7 @@ class PertInference:
 
         if params0 is None:
             params0 = init_params(spec, batch, fixed, t_init=t_init)
+        self._warn_if_enum_tensor_huge(spec, batch)
         batch, params0 = self._maybe_shard(batch, params0)
         mesh = self._mesh if spec.enum_impl in ("pallas",
                                                 "pallas_interpret") else None
