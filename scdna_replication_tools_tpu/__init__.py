@@ -12,7 +12,7 @@ scdna_replication_tools/infer_scRT.py:25, infer_SPF.py:18,
 pert_simulator.py:285, predict_cycle_phase.py:99, ...).
 """
 
-__version__ = "0.1.0"
+__version__ = "0.5.0"
 
 from scdna_replication_tools_tpu.api import scRT, SPF
 from scdna_replication_tools_tpu.config import PertConfig
