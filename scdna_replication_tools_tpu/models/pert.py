@@ -371,6 +371,21 @@ def _joint_logits(P, reads, u, omega, log_pi, phi, lamb, log_lamb,
     return log_pi[..., :, None] + bern[..., None, :] + nb
 
 
+def _shard_mapped(kernel_fn, mesh, specs, interpret):
+    """shard_map a Pallas kernel wrapper over the mesh with layout specs.
+
+    check_vma is skipped because pallas_call's out_shape carries no
+    varying-mesh-axes info (the ops are pointwise over cells)."""
+    in_specs, out_specs = specs
+    return jax.shard_map(
+        functools.partial(kernel_fn, interpret=interpret),
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_vma=False,
+    )
+
+
 def _enum_bin_loglik(spec, reads, u, omega, log_pi, phi, lamb, log_lamb,
                      log1m_lamb, mesh=None):
     """(cells, loci) enumerated bin log-likelihood (states summed out).
@@ -399,16 +414,8 @@ def _enum_bin_loglik(spec, reads, u, omega, log_pi, phi, lamb, log_lamb,
         interpret = spec.enum_impl == "pallas_interpret"
         if mesh is None:
             return enum_loglik(reads, mu, log_pi, phi, lamb, interpret)
-        in_specs, out_specs = enum_shard_specs(mesh)
-        fn = jax.shard_map(
-            functools.partial(enum_loglik, interpret=interpret),
-            mesh=mesh,
-            in_specs=in_specs,
-            out_specs=out_specs,
-            # pallas_call's out_shape carries no varying-mesh-axes info;
-            # skip the vma check (the op is pointwise over cells)
-            check_vma=False,
-        )
+        fn = _shard_mapped(enum_loglik, mesh, enum_shard_specs(mesh),
+                           interpret)
         return fn(reads, mu, log_pi, phi, lamb)
     if spec.enum_impl != "xla":
         raise ValueError(f"unknown enum_impl {spec.enum_impl!r}; expected "
@@ -448,14 +455,8 @@ def _enum_bin_loglik_fused(spec, reads, u, omega, pi_logits_t, phi, etas_t,
     if mesh is None:
         return enum_loglik_fused(reads, mu, pi_logits_t, phi, etas_t, lamb,
                                  interpret)
-    in_specs, out_specs = fused_shard_specs(mesh)
-    fn = jax.shard_map(
-        functools.partial(enum_loglik_fused, interpret=interpret),
-        mesh=mesh,
-        in_specs=in_specs,
-        out_specs=out_specs,
-        check_vma=False,
-    )
+    fn = _shard_mapped(enum_loglik_fused, mesh, fused_shard_specs(mesh),
+                       interpret)
     return fn(reads, mu, pi_logits_t, phi, etas_t, lamb)
 
 
@@ -474,14 +475,8 @@ def _enum_bin_loglik_fused_sparse(spec, reads, u, omega, pi_logits_t, phi,
     if mesh is None:
         return enum_loglik_fused_sparse(reads, mu, pi_logits_t, phi,
                                         eta_idx, eta_w, lamb, interpret)
-    in_specs, out_specs = fused_sparse_shard_specs(mesh)
-    fn = jax.shard_map(
-        functools.partial(enum_loglik_fused_sparse, interpret=interpret),
-        mesh=mesh,
-        in_specs=in_specs,
-        out_specs=out_specs,
-        check_vma=False,
-    )
+    fn = _shard_mapped(enum_loglik_fused_sparse, mesh,
+                       fused_sparse_shard_specs(mesh), interpret)
     return fn(reads, mu, pi_logits_t, phi, eta_idx, eta_w, lamb)
 
 

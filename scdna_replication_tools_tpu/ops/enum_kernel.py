@@ -381,14 +381,17 @@ def _fused_fwd_kernel(scal_ref, reads_ref, mu_ref, phi_ref, pi_ref, *rest,
         eidx = eidx_ref[...]
         ew = ew_ref[...]
 
+    # per-state log-softmax slices, computed once and reused by both the
+    # Dirichlet data term and the chi sweep (13 subtractions, not 26+)
+    lp = [pi_ref[s] - logZ for s in range(P)]
+
     # Dirichlet data term sum_s (etas_s - 1) * log_softmax(pi)_s
     lp_acc = jnp.zeros_like(x)
     for s in range(P):
-        lp = pi_ref[s] - logZ
         if sparse:
-            lp_acc = lp_acc + jnp.where(eidx == float(s), ew, 0.0) * lp
+            lp_acc = lp_acc + jnp.where(eidx == float(s), ew, 0.0) * lp[s]
         else:
-            lp_acc = lp_acc + (etas_ref[s] - 1.0) * lp
+            lp_acc = lp_acc + (etas_ref[s] - 1.0) * lp[s]
 
     # online logsumexp over the (state, rep) product, chi-deduplicated
     # (_chi_slots): the NB core runs once per distinct chi
@@ -397,7 +400,7 @@ def _fused_fwd_kernel(scal_ref, reads_ref, mu_ref, phi_ref, pi_ref, *rest,
     for chi, pairs in _chi_slots(P):
         nb, _ = _nb_core(x, mu, chi, q, log1m_lamb)
         for s, r in pairs:
-            j = pi_ref[s] - logZ + bern[r] + nb
+            j = lp[s] + bern[r] + nb
             m_new = jnp.maximum(m, j)
             acc = acc * jnp.exp(m - m_new) + jnp.exp(j - m_new)
             m = m_new
@@ -428,6 +431,10 @@ def _fused_bwd_kernel(scal_ref, reads_ref, mu_ref, phi_ref, pi_ref, *rest,
         eidx = eidx_ref[...]
         gew = g * ew_ref[...]
 
+    # per-state log-softmax slices, shared by the chi sweep and the
+    # softmax-Jacobian fix below
+    lp = [pi_ref[s] - logZ for s in range(P)]
+
     # init each dlog_pi slot with its Dirichlet term g * (etas_s - 1)
     tot = jnp.zeros_like(x)
     dlp = []  # trace-time accumulators: one ref write per state
@@ -450,7 +457,7 @@ def _fused_bwd_kernel(scal_ref, reads_ref, mu_ref, phi_ref, pi_ref, *rest,
         dmu_slot = ddelta * (mu * (chi * q) > 1.0).astype(jnp.float32) \
             * (chi * q)
         for s, r in pairs:
-            w = jnp.exp(pi_ref[s] - logZ + bern[r] + nb - lse)
+            w = jnp.exp(lp[s] + bern[r] + nb - lse)
             gw = g * w
             dmu = dmu + gw * dmu_slot
             dphi = dphi + gw * dbern[r]
@@ -461,7 +468,7 @@ def _fused_bwd_kernel(scal_ref, reads_ref, mu_ref, phi_ref, pi_ref, *rest,
 
     # softmax Jacobian: dpi_s = dlog_pi_s - softmax_s * sum_s' dlog_pi_s'
     for s in range(P):
-        dpi_ref[s] = dlp[s] - jnp.exp(pi_ref[s] - logZ) * tot
+        dpi_ref[s] = dlp[s] - jnp.exp(lp[s]) * tot
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
