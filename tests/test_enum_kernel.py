@@ -22,6 +22,7 @@ from scdna_replication_tools_tpu.models.pert import (
     pert_loss,
 )
 from scdna_replication_tools_tpu.ops.enum_kernel import (
+    _chi_slots,
     _digamma_ge1,
     _lgamma_ge1,
     enum_loglik,
@@ -43,7 +44,7 @@ def _problem(C=24, L=300, seed=0):
     return reads, mu, logits, phi, jnp.float32(0.75)
 
 
-def _xla_oracle(reads, mu, log_pi, phi, lamb):
+def _xla_oracle(reads, mu, log_pi, phi, lamb, P=P):
     from jax.scipy.special import gammaln, logsumexp
     chi = jnp.arange(P, dtype=jnp.float32)[:, None] * \
         (1.0 + jnp.arange(2, dtype=jnp.float32))[None, :]
@@ -63,6 +64,43 @@ def test_lgamma_digamma_approximations():
     rel = np.abs(lg - sp_gammaln(z)) / np.maximum(np.abs(sp_gammaln(z)), 1.0)
     assert rel.max() < 1e-5
     assert np.abs(dg - sp_digamma(z)).max() < 1e-4
+
+
+@pytest.mark.parametrize("P_", [1, 2, 3, 7, 13, 16])
+def test_chi_slots_cover_every_state_rep_pair_once(P_):
+    """The chi-dedup table must enumerate each (state, rep) pair exactly
+    once with the correct chi = s * (1 + r), for ANY P (P is a config
+    knob, not a constant)."""
+    seen = {}
+    for chi, pairs in _chi_slots(P_):
+        for s, r in pairs:
+            assert (s, r) not in seen, (s, r)
+            seen[(s, r)] = chi
+            assert chi == float(s * (1 + r)), (s, r, chi)
+    assert len(seen) == 2 * P_
+    # the dedup must actually dedup: distinct chi count < pair count
+    # whenever a collision exists (P >= 3 has s=2, r=0 vs s=1, r=1)
+    if P_ >= 3:
+        assert len(_chi_slots(P_)) < 2 * P_
+
+
+@pytest.mark.parametrize("P_", [3, 7])
+def test_forward_parity_at_nondefault_P(P_):
+    """Kernel parity at P values other than 13 pins _chi_slots + the
+    unrolled loops' generality (P is PertConfig-settable)."""
+    rng = np.random.default_rng(17)
+    C, L = 8, 96
+    reads = jnp.asarray(rng.poisson(30, (C, L)).astype(np.float32))
+    mu = jnp.asarray(rng.uniform(2, 20, (C, L)).astype(np.float32))
+    logits = jnp.asarray(rng.normal(0, 2, (C, L, P_)).astype(np.float32))
+    phi = jnp.asarray(rng.uniform(0.05, 0.95, (C, L)).astype(np.float32))
+    lamb = jnp.float32(0.7)
+    log_pi = jax.nn.log_softmax(logits, -1)
+
+    ll_ref = _xla_oracle(reads, mu, log_pi, phi, lamb, P=P_)
+    ll_pal = enum_loglik(reads, mu, log_pi, phi, lamb, True)
+    rel = jnp.max(jnp.abs(ll_ref - ll_pal) / (jnp.abs(ll_ref) + 1.0))
+    assert float(rel) < 1e-3, float(rel)
 
 
 def test_forward_parity_with_xla_oracle():
