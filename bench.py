@@ -309,7 +309,7 @@ def _run(args, platform, probe_attempts=None):
     cells_per_sec = args.cells / jax_per_iter
 
     if args.skip_baseline:
-        vs = float("nan")
+        vs = None  # JSON null — a bare NaN breaks strict (RFC 8259) parsers
         cpu_per_iter = None
     else:
         cpu_per_iter, _ = bench_torch_cpu(args.cells, args.loci, args.P,
@@ -321,7 +321,7 @@ def _run(args, platform, probe_attempts=None):
         "value": round(cells_per_sec, 1),
         "unit": f"cells/sec ({args.cells}x{args.loci} bins, P={args.P}, "
                 f"enumerated SVI step)",
-        "vs_baseline": round(vs, 2),
+        "vs_baseline": None if vs is None else round(vs, 2),
         "platform": platform,
         # enum_impl round-trips into PertConfig.enum_impl; the sparse
         # winner is the same kernel with PertConfig.sparse_etas=True

@@ -26,10 +26,16 @@ from __future__ import annotations
 
 import argparse
 import json
+import pathlib
 import sys
 
 import numpy as np
 import pandas as pd
+
+# make the repo-root package importable when invoked as a script, without
+# requiring PYTHONPATH (which can shadow the environment's sitecustomize
+# and break ambient accelerator-backend registration)
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
 
 
 def make_workload(num_cells=40, num_loci=150, seed=11):

@@ -22,11 +22,17 @@ from __future__ import annotations
 
 import argparse
 import json
+import pathlib
 import sys
 import time
 
 import numpy as np
 import pandas as pd
+
+# make the repo-root package importable when invoked as a script, without
+# requiring PYTHONPATH (which can shadow the environment's sitecustomize
+# and break ambient accelerator-backend registration)
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
 
 
 def make_genome_workload(num_s_cells, num_g1_cells, bin_size=500_000,
