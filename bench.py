@@ -258,7 +258,8 @@ def _parse_args(argv=None):
     ap.add_argument("--baseline-iters", type=int, default=20)
     ap.add_argument("--skip-baseline", action="store_true")
     ap.add_argument("--enum-impl", default="auto",
-                    choices=["auto", "xla", "pallas", "pallas_interpret"])
+                    choices=["auto", "xla", "pallas", "pallas_sparse",
+                             "pallas_interpret"])
     ap.add_argument("--platform", default="auto",
                     choices=["auto", "tpu", "cpu"],
                     help="'auto' probes the ambient backend in a "
@@ -275,14 +276,32 @@ def _run(args, platform, probe_attempts=None):
     iters = min(args.iters, args.cpu_iters) if on_cpu else args.iters
 
     from scdna_replication_tools_tpu.ops.enum_kernel import resolve_enum_impl
-    impl = resolve_enum_impl(args.enum_impl)
-    if args.enum_impl == "auto" and impl == "pallas":
-        # on TPU, race the production configuration (fused kernel with the
-        # sparse one-hot prior encoding — what the runner auto-selects)
-        # against the dense-etas kernel and the XLA broadcast path
-        candidates = ["pallas_sparse", "pallas", "xla"]
+    # "pallas_sparse" is a BENCH-LOCAL alias for the production pairing
+    # (enum_impl='pallas', PertConfig.sparse_etas=True) — sparse_etas is a
+    # config flag, not a member of resolve_enum_impl's impl whitelist, so
+    # the alias is resolved here and never passed to the model layer
+    if args.enum_impl == "pallas_sparse":
+        candidates = ["pallas_sparse"]
     else:
-        candidates = [impl]
+        impl = resolve_enum_impl(args.enum_impl)
+        if args.enum_impl == "auto" and impl == "pallas":
+            # on TPU, race the production configuration (fused kernel with
+            # the sparse one-hot prior encoding — what the runner
+            # auto-selects) against the dense-etas kernel and the XLA
+            # broadcast path
+            candidates = ["pallas_sparse", "pallas", "xla"]
+            # the XLA path materialises the (cells, loci, P, 2) tensor;
+            # past ~4 GB its residuals host-OOM-kill the whole process on
+            # tunneled backends (no catchable exception), forfeiting the
+            # working candidates — skip it, loudly
+            enum_gb = args.cells * args.loci * args.P * 2 * 4 / 1e9
+            if enum_gb > 4.0:
+                candidates.remove("xla")
+                print(f"bench: skipping xla candidate (enumeration tensor "
+                      f"{enum_gb:.1f} GB > 4 GB would risk a host OOM "
+                      "kill)", file=sys.stderr)
+        else:
+            candidates = [impl]
 
     jax_per_iter, winner, errors = float("inf"), None, []
     candidate_secs = {}

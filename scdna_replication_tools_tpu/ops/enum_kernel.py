@@ -18,7 +18,7 @@ This module computes
     delta(mu, s, r) = max(mu * s * (1 + r) * (1 - lamb) / lamb, 1)
 
 as one Pallas kernel over (cells, loci) tiles: the 26-way state product
-lives in VMEM registers of an online logsumexp, and only the (cells, loci)
+lives in VMEM registers of a two-pass logsumexp, and only the (cells, loci)
 result ever touches HBM.  The backward pass is a second kernel that
 *recomputes* the state logits from the same inputs and directly emits
 dmu, dlog_pi, dphi — the classic flash-attention trade: 2x the
@@ -48,7 +48,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 # default tile sizes: lane dim 512 amortises control overhead, sublane 8
-# matches the f32 tile; (8, 512) x ~30 live buffers stays far under VMEM
+# matches the f32 tile; (8, 512) x ~50 live buffers (incl. the 19 resident
+# per-chi NB tiles of the two-pass logsumexp) stays far under VMEM
 TILE_C = 8
 TILE_L = 512
 
@@ -89,11 +90,55 @@ def _digamma_ge1(z):
     return jnp.where(z < 8.0, psi - shift_sum, psi)
 
 
+def _lgamma_digamma_ge1(z):
+    """(lgamma(z), digamma(z)) for z >= 1, fused.
+
+    The backward kernels need BOTH functions of the SAME argument (nb for
+    the posterior weight, psi for d nb/d delta).  Evaluated separately
+    they duplicate the expensive shared subexpressions — min/where of the
+    recurrence, log(zz), 1/zz, inv^2, and the shifted (zs+i) terms; this
+    helper computes them once.  Bit-identical to calling _lgamma_ge1 and
+    _digamma_ge1 (same operations, same order per output).
+    """
+    zs = jnp.minimum(z, 8.0)
+    t1, t2, t3 = zs + 1.0, zs + 2.0, zs + 3.0
+    t4, t5, t6, t7 = zs + 4.0, zs + 5.0, zs + 6.0, zs + 7.0
+    shift_prod = zs * t1 * t2 * t3 * t4 * t5 * t6 * t7
+    shift_sum = (1.0 / zs + 1.0 / t1 + 1.0 / t2 + 1.0 / t3
+                 + 1.0 / t4 + 1.0 / t5 + 1.0 / t6 + 1.0 / t7)
+    zz = jnp.where(z < 8.0, z + 8.0, z)
+    inv = 1.0 / zz
+    inv2 = inv * inv
+    logzz = jnp.log(zz)
+    series = inv * (1.0 / 12.0 + inv2 * (-1.0 / 360.0 + inv2 * (1.0 / 1260.0)))
+    st = (zz - 0.5) * logzz - zz + _HALF_LOG_2PI + series
+    lg = jnp.where(z < 8.0, st - jnp.log(shift_prod), st)
+    psi = (logzz - 0.5 * inv
+           - inv2 * (1.0 / 12.0 + inv2 * (-1.0 / 120.0 + inv2 * (1.0 / 252.0))))
+    psi = jnp.where(z < 8.0, psi - shift_sum, psi)
+    return lg, psi
+
+
 def _nb_core(x, mu, chi, q, log1m_lamb):
     """State-dependent part of the NB log-pmf (see module docstring)."""
     delta = jnp.maximum(mu * (chi * q), 1.0)
     return (_lgamma_ge1(x + delta) - _lgamma_ge1(delta)
             + delta * log1m_lamb), delta
+
+
+def _nb_core_bwd(x, mu, chi, q, log1m_lamb):
+    """Backward-pass NB core: (nb, d nb/d delta, delta) in one sweep.
+
+    Uses the fused lgamma+digamma evaluation — the backward kernels need
+    both functions at both arguments (x + delta and delta), and fusing
+    shares each argument's log/reciprocal/recurrence machinery.
+    """
+    delta = jnp.maximum(mu * (chi * q), 1.0)
+    lg_xd, psi_xd = _lgamma_digamma_ge1(x + delta)
+    lg_d, psi_d = _lgamma_digamma_ge1(delta)
+    nb = lg_xd - lg_d + delta * log1m_lamb
+    ddelta = psi_xd - psi_d + log1m_lamb
+    return nb, ddelta, delta
 
 
 def _chi_slots(P):
@@ -134,20 +179,27 @@ def _fwd_kernel(scal_ref, reads_ref, mu_ref, phi_ref, log_pi_ref, out_ref,
     mu = mu_ref[...]
     phi = phi_ref[...]
     bern = (jnp.log1p(-phi), jnp.log(phi))
+    lgx1 = _lgamma_ge1(x + 1.0)
 
-    # online logsumexp over the 26 (state, rep) pairs, sweeping the 19
-    # DISTINCT chi values (_chi_slots): the NB core runs once per slot
+    # two-pass logsumexp over the 26 (state, rep) pairs, sweeping the 19
+    # DISTINCT chi values (_chi_slots): the NB core runs once per slot and
+    # its tile stays resident in VMEM between the passes.  Max-then-sum
+    # needs half the exps of an online rescale and keeps exp off the
+    # loop-carried dependency chain.  chi = 0: delta is identically 1
+    # (clamp), so its nb reuses the hoisted lgamma(x+1)
+    slots = _chi_slots(P)
+    nbs = [lgx1 + log1m_lamb if chi == 0.0
+           else _nb_core(x, mu, chi, q, log1m_lamb)[0]
+           for chi, _ in slots]
     m = jnp.full_like(x, -jnp.inf)
-    acc = jnp.zeros_like(x)
-    for chi, pairs in _chi_slots(P):
-        nb, _ = _nb_core(x, mu, chi, q, log1m_lamb)
+    for nb, (_, pairs) in zip(nbs, slots):
         for s, r in pairs:
-            j = log_pi_ref[s] + bern[r] + nb
-            m_new = jnp.maximum(m, j)
-            acc = acc * jnp.exp(m - m_new) + jnp.exp(j - m_new)
-            m = m_new
-    out_ref[...] = (m + jnp.log(acc)
-                    + x * log_lamb - _lgamma_ge1(x + 1.0))
+            m = jnp.maximum(m, log_pi_ref[s] + bern[r] + nb)
+    acc = jnp.zeros_like(x)
+    for nb, (_, pairs) in zip(nbs, slots):
+        for s, r in pairs:
+            acc = acc + jnp.exp(log_pi_ref[s] + bern[r] + nb - m)
+    out_ref[...] = m + jnp.log(acc) + x * log_lamb - lgx1
 
 
 def _bwd_kernel(scal_ref, reads_ref, mu_ref, phi_ref, log_pi_ref, ll_ref,
@@ -162,7 +214,8 @@ def _bwd_kernel(scal_ref, reads_ref, mu_ref, phi_ref, log_pi_ref, ll_ref,
     g = g_ref[...]
     # subtract the hoisted state-independent terms so that
     # w = exp(j_state - ll_state) normalises over the 26 states
-    ll_state = ll_ref[...] - (x * log_lamb - _lgamma_ge1(x + 1.0))
+    lgx1 = _lgamma_ge1(x + 1.0)
+    ll_state = ll_ref[...] - (x * log_lamb - lgx1)
     bern = (jnp.log1p(-phi), jnp.log(phi))
     dbern = (-1.0 / (1.0 - phi), 1.0 / phi)
 
@@ -170,20 +223,25 @@ def _bwd_kernel(scal_ref, reads_ref, mu_ref, phi_ref, log_pi_ref, ll_ref,
     dmu = zero
     dphi = zero
     dlp = [zero] * P  # trace-time accumulators: one ref write per state
-    # chi sweep (see _chi_slots): the NB core + its digamma derivative
-    # run once per distinct chi; each (s, rep) pair sharing it
-    # accumulates into the gradients
+    # chi sweep (see _chi_slots): the fused-lgamma+digamma NB core runs
+    # once per distinct chi; each (s, rep) pair sharing it accumulates
+    # into the gradients.  chi = 0 shortcut: delta is identically 1
+    # (clamp), so nb = lgamma(x+1) + log1m_lamb — already computed above —
+    # and dmu_slot vanishes (the clamp gate is 0 everywhere)
     for chi, pairs in _chi_slots(P):
-        nb, delta = _nb_core(x, mu, chi, q, log1m_lamb)
-        # d nb / d delta, gated on the delta > 1 clamp region
-        ddelta = (_digamma_ge1(x + delta) - _digamma_ge1(delta)
-                  + log1m_lamb)
-        dmu_slot = ddelta * (mu * (chi * q) > 1.0).astype(jnp.float32) \
-            * (chi * q)
+        if chi == 0.0:
+            nb = lgx1 + log1m_lamb
+            dmu_slot = None
+        else:
+            nb, ddelta, _ = _nb_core_bwd(x, mu, chi, q, log1m_lamb)
+            # d nb / d delta, gated on the delta > 1 clamp region
+            dmu_slot = ddelta * (mu * (chi * q) > 1.0).astype(jnp.float32) \
+                * (chi * q)
         for s, r in pairs:
             w = jnp.exp(log_pi_ref[s] + bern[r] + nb - ll_state)
             gw = g * w
-            dmu = dmu + gw * dmu_slot
+            if dmu_slot is not None:
+                dmu = dmu + gw * dmu_slot
             dphi = dphi + gw * dbern[r]
             dlp[s] = dlp[s] + gw
     for s in range(P):
@@ -343,18 +401,17 @@ enum_loglik.defvjp(lambda r, m, lp, p, la, i: _enum_fwd(r, m, lp, p, la, i),
 
 
 def _logZ(pi_ref, P, like):
-    """Per-bin log-normaliser of pi_logits over the P state slices."""
-    m = jnp.full_like(like, -jnp.inf)
+    """Per-bin log-normaliser of pi_logits over the P state slices.
+
+    Two-pass (max, then sum-of-exp) rather than an online rescale: P
+    static exps instead of 2P, and the serial dependency chain carries
+    only cheap maxes/adds instead of exps."""
+    m = pi_ref[0]
+    for s in range(1, P):
+        m = jnp.maximum(m, pi_ref[s])
     z = jnp.zeros_like(like)
-
-    def body(s, carry):
-        m, z = carry
-        x = pi_ref[s]
-        m_new = jnp.maximum(m, x)
-        z = z * jnp.exp(m - m_new) + jnp.exp(x - m_new)
-        return m_new, z
-
-    m, z = jax.lax.fori_loop(0, P, body, (m, z))
+    for s in range(P):
+        z = z + jnp.exp(pi_ref[s] - m)
     return m + jnp.log(z)
 
 
@@ -393,20 +450,26 @@ def _fused_fwd_kernel(scal_ref, reads_ref, mu_ref, phi_ref, pi_ref, *rest,
         else:
             lp_acc = lp_acc + (etas_ref[s] - 1.0) * lp[s]
 
-    # online logsumexp over the (state, rep) product, chi-deduplicated
-    # (_chi_slots): the NB core runs once per distinct chi
+    # two-pass logsumexp over the (state, rep) product, chi-deduplicated
+    # (_chi_slots): the NB core runs once per distinct chi, its tiles
+    # stay in VMEM between passes; see _fwd_kernel for why max-then-sum
+    # beats the online rescale on the VPU (and the chi = 0 reuse)
+    lgx1 = _lgamma_ge1(x + 1.0)
+    slots = _chi_slots(P)
+    nbs = [lgx1 + log1m_lamb if chi == 0.0
+           else _nb_core(x, mu, chi, q, log1m_lamb)[0]
+           for chi, _ in slots]
     m = jnp.full_like(x, -jnp.inf)
-    acc = jnp.zeros_like(x)
-    for chi, pairs in _chi_slots(P):
-        nb, _ = _nb_core(x, mu, chi, q, log1m_lamb)
+    for nb, (_, pairs) in zip(nbs, slots):
         for s, r in pairs:
-            j = lp[s] + bern[r] + nb
-            m_new = jnp.maximum(m, j)
-            acc = acc * jnp.exp(m - m_new) + jnp.exp(j - m_new)
-            m = m_new
+            m = jnp.maximum(m, lp[s] + bern[r] + nb)
+    acc = jnp.zeros_like(x)
+    for nb, (_, pairs) in zip(nbs, slots):
+        for s, r in pairs:
+            acc = acc + jnp.exp(lp[s] + bern[r] + nb - m)
     lse = m + jnp.log(acc)
     lse_ref[...] = lse
-    out_ref[...] = (lse + x * log_lamb - _lgamma_ge1(x + 1.0) + lp_acc)
+    out_ref[...] = lse + x * log_lamb - lgx1 + lp_acc
 
 
 def _fused_bwd_kernel(scal_ref, reads_ref, mu_ref, phi_ref, pi_ref, *rest,
@@ -448,18 +511,23 @@ def _fused_bwd_kernel(scal_ref, reads_ref, mu_ref, phi_ref, pi_ref, *rest,
 
     dmu = jnp.zeros_like(x)
     dphi = jnp.zeros_like(x)
-    # chi sweep (see _chi_slots): NB core + digamma derivative once per
-    # distinct chi; posterior weights accumulate into the shared slots
+    # chi sweep (see _chi_slots): the fused-lgamma+digamma NB core runs
+    # once per distinct chi; posterior weights accumulate into the shared
+    # slots.  chi = 0: delta is identically 1 (clamp), so nb needs only
+    # lgamma(x+1) and the dmu contribution vanishes (clamp gate is 0)
     for chi, pairs in _chi_slots(P):
-        nb, delta = _nb_core(x, mu, chi, q, log1m_lamb)
-        ddelta = (_digamma_ge1(x + delta) - _digamma_ge1(delta)
-                  + log1m_lamb)
-        dmu_slot = ddelta * (mu * (chi * q) > 1.0).astype(jnp.float32) \
-            * (chi * q)
+        if chi == 0.0:
+            nb = _lgamma_ge1(x + 1.0) + log1m_lamb
+            dmu_slot = None
+        else:
+            nb, ddelta, _ = _nb_core_bwd(x, mu, chi, q, log1m_lamb)
+            dmu_slot = ddelta * (mu * (chi * q) > 1.0).astype(jnp.float32) \
+                * (chi * q)
         for s, r in pairs:
             w = jnp.exp(lp[s] + bern[r] + nb - lse)
             gw = g * w
-            dmu = dmu + gw * dmu_slot
+            if dmu_slot is not None:
+                dmu = dmu + gw * dmu_slot
             dphi = dphi + gw * dbern[r]
             dlp[s] = dlp[s] + gw
             tot = tot + gw

@@ -66,6 +66,22 @@ def test_lgamma_digamma_approximations():
     assert np.abs(dg - sp_digamma(z)).max() < 1e-4
 
 
+def test_fused_lgamma_digamma_matches_separate_helpers():
+    """The backward kernels' fused evaluation must be bit-identical to the
+    separate helpers it replaced (same ops, same order per output)."""
+    from scdna_replication_tools_tpu.ops.enum_kernel import (
+        _lgamma_digamma_ge1,
+    )
+
+    z = jnp.asarray(np.random.default_rng(2)
+                    .uniform(1.0, 5e4, 20000).astype(np.float32))
+    lg_f, dg_f = _lgamma_digamma_ge1(z)
+    np.testing.assert_array_equal(np.asarray(lg_f),
+                                  np.asarray(_lgamma_ge1(z)))
+    np.testing.assert_array_equal(np.asarray(dg_f),
+                                  np.asarray(_digamma_ge1(z)))
+
+
 @pytest.mark.parametrize("P_", [1, 2, 3, 7, 13, 16])
 def test_chi_slots_cover_every_state_rep_pair_once(P_):
     """The chi-dedup table must enumerate each (state, rep) pair exactly
