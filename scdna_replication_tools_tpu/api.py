@@ -52,7 +52,7 @@ class scRT:
                  run_step3=True, backend='jax', num_shards=1,
                  loci_shards=1, cell_chunk=None, checkpoint_dir=None,
                  enum_impl='auto', cn_hmm_self_prob=None,
-                 rho_from_rt_prior=False):
+                 rho_from_rt_prior=False, mirror_rescue=False):
         self.cn_s = cn_s
         self.cn_g1 = cn_g1
         self.clone_col = clone_col
@@ -78,11 +78,13 @@ class scRT:
             checkpoint_dir=checkpoint_dir, enum_impl=enum_impl,
             cn_hmm_self_prob=cn_hmm_self_prob,
             rho_from_rt_prior=rho_from_rt_prior,
+            mirror_rescue=mirror_rescue,
         )
 
         self.clone_profiles = None
         self.bulk_cn = None
         self.manhattan_df = None
+        self.mirror_rescue_stats = None  # set by infer(level='pert')
 
     # -- dispatch (reference: infer_scRT.py:108-124) ----------------------
 
@@ -154,6 +156,8 @@ class scRT:
             num_clones=len(clone_ids),
         )
         step1, step2, step3 = inference.run()
+        # surfaced for callers/tools (None unless mirror_rescue ran)
+        self.mirror_rescue_stats = inference.mirror_rescue_stats
 
         lamb = float(np.asarray(
             constrained(step1.spec, step1.fit.params, step1.fixed)["lamb"]

@@ -27,6 +27,9 @@ def infer_scrt_main(argv=None):
     p.add_argument("--cn-prior-method", default="g1_composite")
     p.add_argument("--clone-col", default="clone_id")
     p.add_argument("--num-shards", type=int, default=1)
+    p.add_argument("--mirror-rescue", action="store_true",
+                   help="post-step-2 mirror-basin rescue for boundary-tau "
+                        "cells (beyond-reference; PertConfig.mirror_rescue)")
     args = p.parse_args(argv)
 
     from scdna_replication_tools_tpu.api import scRT
@@ -36,7 +39,8 @@ def infer_scrt_main(argv=None):
 
     scrt = scRT(cn_s, cn_g1, clone_col=args.clone_col,
                 cn_prior_method=args.cn_prior_method,
-                max_iter=args.max_iter, num_shards=args.num_shards)
+                max_iter=args.max_iter, num_shards=args.num_shards,
+                mirror_rescue=args.mirror_rescue)
     out_df, supp_df, _, _ = scrt.infer(level=args.level)
 
     out_df.to_csv(args.output, sep="\t", index=False)

@@ -118,6 +118,33 @@ class PertConfig:
     # (pert_model.py:260-269); None keeps the reference's independent
     # per-bin argmax decode.
     cn_hmm_self_prob: Optional[float] = None
+    # opt-in post-step-2 mirror rescue (beyond the reference).  PERT's
+    # step-2 objective has a mirror degeneracy at the S-phase extremes: a
+    # nearly-fully-replicated cell (tau -> 1) at read rate u is
+    # likelihood-equivalent to an unreplicated cell (tau -> 0) at rate
+    # ~2u, and the u prior's mean tracks the fitted tau
+    # (pert_model.py:597-600), so BOTH basins are self-consistent — the
+    # reference's prior-free `expose_tau` param (pert_model.py:583)
+    # inherits the wrong basin when guess_times' skew heuristic
+    # mis-reads a near-uniform profile.  With mirror_rescue=True, cells
+    # whose fitted tau lands outside [mirror_tau_lo, mirror_tau_hi] are
+    # re-fit from the mirrored initialisation (tau' = 1 - tau; u re-seeded
+    # by the prior at tau') with every global site conditioned, and each
+    # cell keeps whichever fit scores the higher per-cell log-joint.
+    # Strictly objective-improving per cell; default off for
+    # reference-faithful behaviour.
+    mirror_rescue: bool = False
+    mirror_tau_lo: float = 0.1
+    mirror_tau_hi: float = 0.9
+    mirror_max_iter: int = 400
+    mirror_min_iter: int = 50
+    # hard bound on the rescue sub-fit's size: the most boundary-extreme
+    # cells (smallest min(tau, 1 - tau)) are taken first.  Bounds both
+    # the re-fit and the per-cell scoring pass (which uses the dense XLA
+    # enumeration tensor) on cohorts where many cells are LEGITIMATELY
+    # early/late-S — those candidates would be rejected by the objective
+    # comparison anyway, at near-full-refit cost.
+    mirror_max_cells: int = 256
 
     def resolved_iters(self) -> dict:
         """Step 1/3 budgets default to half of step 2's (pert_model.py:104-120)."""

@@ -45,10 +45,15 @@ from scdna_replication_tools_tpu.models.pert import (
     constrained,
     decode_discrete,
     init_params,
+    per_cell_objective,
     pert_loss,
 )
 from scdna_replication_tools_tpu.ops.gc import gc_features
 from scdna_replication_tools_tpu.ops.stats import guess_times, pearson_matrix
+from scdna_replication_tools_tpu.ops.transforms import (
+    to_positive,
+    to_unit_interval,
+)
 from scdna_replication_tools_tpu.utils import profiling
 from scdna_replication_tools_tpu.parallel.mesh import (
     CELLS_AXIS,
@@ -126,6 +131,7 @@ class PertInference:
         self.clone_idx_g1 = clone_idx_g1
         self.num_clones = num_clones
         self.L = s_data.num_libraries
+        self.mirror_rescue_stats = None  # filled by _mirror_rescue
         if config.rho_from_rt_prior and s_data.rt_prior is None:
             # fail fast: surfacing this inside run_step2 would waste the
             # whole step-1 fit first
@@ -409,7 +415,137 @@ class PertInference:
         out = self._fit(spec, batch, fixed, t_init,
                         iters["max_iter"], iters["min_iter"], "step2")
         self._step2_data = s
+        if self.config.mirror_rescue:
+            out = self._mirror_rescue(out, batch)
         return out
+
+    def _mirror_rescue(self, out: StepOutput, batch: PertBatch) -> StepOutput:
+        """Post-step-2 mirror-basin rescue (``PertConfig.mirror_rescue``).
+
+        The step-2 objective is mirror-degenerate at the S-phase extremes:
+        a nearly-fully-replicated cell at read rate u is
+        likelihood-equivalent to an unreplicated cell at rate ~2u, and
+        the u prior's mean tracks the fitted tau (pert_model.py:597-600),
+        so both basins are self-consistent for the reference's prior-free
+        ``expose_tau`` param (pert_model.py:583) — whichever basin
+        ``guess_times`` lands in wins, and its skew heuristic
+        (pert_model.py:387-400) mis-reads near-uniform profiles.
+
+        Cells whose fitted tau lies outside [mirror_tau_lo, mirror_tau_hi]
+        are re-fit from the mirrored initialisation (tau' = 1 - tau; u is
+        re-seeded by its own prior at tau', which is exactly the mirrored
+        rate) with every global site (rho, a, beta_means, lambda)
+        conditioned at the step-2 fit, and each cell keeps whichever
+        parameterisation scores the higher per-cell log-joint
+        (models.pert.per_cell_objective).  Per-cell selection makes the
+        pass strictly objective-improving; a beyond-reference capability,
+        default off.
+        """
+        cfg = self.config
+        # candidate scan from tau_raw alone — constrained() would also
+        # materialise log_pi AND pi, two (P, cells, loci) tensors the
+        # fused training path deliberately never builds (GBs at genome
+        # scale), just to read three cheap sites
+        tau = np.asarray(to_unit_interval(out.fit.params["tau_raw"]))
+        mask = np.asarray(batch.mask)
+        cand = np.flatnonzero(((tau < cfg.mirror_tau_lo)
+                               | (tau > cfg.mirror_tau_hi)) & (mask > 0.5))
+        self.mirror_rescue_stats = {"candidates": int(cand.size),
+                                    "accepted": 0}
+        if cand.size == 0:
+            return out
+        if cand.size > cfg.mirror_max_cells:
+            # bound the sub-fit: most boundary-extreme first (mirrored
+            # cells sit at tau ~ 0.005; genuinely early-S cells land
+            # higher) — see PertConfig.mirror_max_cells
+            extremity = np.minimum(tau[cand], 1.0 - tau[cand])
+            cand = cand[np.argsort(extremity)[:cfg.mirror_max_cells]]
+            profiling.logger.info(
+                "mirror rescue: capping %d candidates to the %d most "
+                "boundary-extreme (PertConfig.mirror_max_cells)",
+                self.mirror_rescue_stats["candidates"],
+                cfg.mirror_max_cells)
+            self.mirror_rescue_stats["capped_to"] = int(cand.size)
+
+        def _take(x):
+            return None if x is None else jnp.asarray(np.asarray(x)[cand])
+
+        sub_batch = PertBatch(
+            reads=_take(batch.reads),
+            libs=_take(batch.libs),
+            gamma_feats=batch.gamma_feats,
+            mask=jnp.ones((cand.size,), jnp.float32),
+            loci_mask=batch.loci_mask,
+            etas=_take(batch.etas),
+            eta_idx=_take(batch.eta_idx),
+            eta_w=_take(batch.eta_w),
+        )
+        # all global sites conditioned: the rescue fit moves ONLY the
+        # candidates' per-cell sites, so splicing them back cannot shift
+        # the other cells' objective
+        spec = dataclasses.replace(out.spec, cond_rho=True, cond_a=True,
+                                   cell_chunk=None)
+        fixed = dict(out.fixed)
+        fixed["rho"] = jnp.asarray(fixed["rho"]) if out.spec.cond_rho \
+            else to_unit_interval(out.fit.params["rho_raw"])
+        fixed["a"] = jnp.asarray(fixed["a"]) if out.spec.cond_a \
+            else to_positive(out.fit.params["a_raw"])
+
+        # np.array (copy): np.asarray of a jax array is a read-only view,
+        # and the accepted cells are spliced into these buffers below
+        params_np = {k: np.array(v) for k, v in out.fit.params.items()}
+        orig_sub = {
+            "tau_raw": jnp.asarray(params_np["tau_raw"][cand]),
+            "u": jnp.asarray(params_np["u"][cand]),
+            "betas": jnp.asarray(params_np["betas"][cand]),
+            "pi_logits": jnp.asarray(params_np["pi_logits"][:, cand, :]),
+            "beta_stds_raw": jnp.asarray(params_np["beta_stds_raw"]),
+        }
+
+        t_flip = np.clip(1.0 - tau[cand], 0.05, 0.95).astype(np.float32)
+        params0 = init_params(spec, sub_batch, fixed, t_init=t_flip)
+        # warm-seed the sites the flip does NOT mirror: beta_stds (the
+        # betas-prior width the candidates are later SCORED under — a
+        # cold logspace init would optimise them against a different
+        # width than the acceptance comparison uses) and the incumbent
+        # GC coefficients (basin-independent)
+        params0["beta_stds_raw"] = orig_sub["beta_stds_raw"]
+        params0["betas"] = orig_sub["betas"]
+
+        def loss_fn(params, fixed_, batch_):
+            return pert_loss(spec, params, fixed_, batch_)
+
+        fit = fit_map(loss_fn, params0, (fixed, sub_batch),
+                      max_iter=cfg.mirror_max_iter,
+                      min_iter=cfg.mirror_min_iter,
+                      rel_tol=cfg.rel_tol, learning_rate=cfg.learning_rate,
+                      b1=cfg.adam_b1, b2=cfg.adam_b2)
+
+        # compare under the ORIGINAL beta_stds (a global pyro param the
+        # sub-fit also moves; discarding its drift keeps the per-cell
+        # ranking apples-to-apples and the spliced params consistent)
+        rescued = dict(fit.params)
+        rescued["beta_stds_raw"] = orig_sub["beta_stds_raw"]
+        obj_orig = np.asarray(per_cell_objective(spec, orig_sub, fixed,
+                                                 sub_batch))
+        obj_new = np.asarray(per_cell_objective(spec, rescued, fixed,
+                                                sub_batch))
+        accept = obj_new > obj_orig
+        self.mirror_rescue_stats["accepted"] = int(accept.sum())
+        profiling.logger.info(
+            "mirror rescue: %d boundary-tau candidates, %d accepted "
+            "(per-cell log-joint improved)", cand.size, int(accept.sum()))
+        if not accept.any():
+            return out
+
+        keep = cand[accept]
+        res_np = {k: np.asarray(v) for k, v in rescued.items()}
+        for key in ("tau_raw", "u", "betas"):
+            params_np[key][keep] = res_np[key][accept]
+        params_np["pi_logits"][:, keep, :] = res_np["pi_logits"][:, accept, :]
+        new_params = {k: jnp.asarray(v) for k, v in params_np.items()}
+        new_fit = dataclasses.replace(out.fit, params=new_params)
+        return dataclasses.replace(out, fit=new_fit)
 
     def run_step3(self, step1: StepOutput, step2: StepOutput) -> StepOutput:
         iters = self.config.resolved_iters()

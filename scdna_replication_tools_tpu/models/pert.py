@@ -492,6 +492,37 @@ def _observed_bin_loglik(spec, reads, u, omega, log_pi, phi, cn_obs, rep_obs,
     return lp_cn + lp_rep + lp_reads
 
 
+def _dirichlet_pi_term(P: int, batch: PertBatch, log_pi: jnp.ndarray,
+                       sparse: bool) -> jnp.ndarray:
+    """(cells, loci) FULL Dirichlet pi term — data term + normaliser —
+    for the paths that materialise log_pi (the fused kernels fold the
+    data term and keep only the normaliser; see log_joint).
+
+    Single owner of this computation: the mirror-rescue acceptance rule
+    (infer/runner.py) compares per-cell objectives and splices the winner
+    back into the training state, which is strictly objective-improving
+    ONLY while ``per_cell_objective`` and ``log_joint`` evaluate this
+    term identically — so both call here.
+    """
+    if sparse:
+        # one-hot Dirichlet normaliser in analytic form: the dense path's
+        # ~1.3e7-magnitude gammaln cancellation is already done
+        # symbolically here (gammaln(P + w) - gammaln(1 + w) ~ 1e2)
+        return (gammaln(P + batch.eta_w) - gammaln(1.0 + batch.eta_w)
+                + batch.eta_w * jnp.take_along_axis(
+                    log_pi, batch.eta_idx.astype(jnp.int32)[..., None],
+                    axis=-1)[..., 0])
+    etas = batch.etas if batch.etas is not None else \
+        jnp.ones(batch.reads.shape + (P,), jnp.float32)
+    # parenthesisation matters: the two gammaln terms are ~1.3e7 at
+    # the default 1e6 concentrations and cancel to ~1e2 — adding the
+    # small data term BEFORE the cancellation would absorb it into
+    # f32 rounding (spacing is 1.0 at that magnitude, ~1 per bin)
+    return (jnp.sum((etas - 1.0) * log_pi, axis=-1)
+            + (gammaln(jnp.sum(etas, axis=-1))
+               - jnp.sum(gammaln(etas), axis=-1)))
+
+
 def log_joint(spec: PertModelSpec, params: dict, fixed: dict,
               batch: PertBatch, mesh=None) -> jnp.ndarray:
     """Total log-joint (the negative of the SVI loss), discretes summed out."""
@@ -527,16 +558,15 @@ def log_joint(spec: PertModelSpec, params: dict, fixed: dict,
                 "spec.sparse_etas=True but the batch carries no "
                 "eta_idx/eta_w planes (priors.sparsify_etas builds them)")
         eta_idx, eta_w = batch.eta_idx, batch.eta_w
-        # one-hot Dirichlet normaliser in analytic form: the dense path's
-        # ~1.3e7-magnitude gammaln cancellation is already done
-        # symbolically here (gammaln(P + w) - gammaln(1 + w) ~ 1e2)
-        lp_pi = gammaln(spec.P + eta_w) - gammaln(1.0 + eta_w)
         if fused:
+            # the kernel folds the data term; only the (analytic,
+            # parameter-free) normaliser stays host-side — see
+            # _dirichlet_pi_term for the full-form owner
+            lp_pi = gammaln(spec.P + eta_w) - gammaln(1.0 + eta_w)
             pi_like = params["pi_logits"]
         else:
             log_pi = c["log_pi"]
-            lp_pi = lp_pi + eta_w * jnp.take_along_axis(
-                log_pi, eta_idx.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+            lp_pi = _dirichlet_pi_term(spec.P, batch, log_pi, sparse=True)
             pi_like = log_pi
     else:
         if batch.etas is None and batch.eta_idx is not None:
@@ -557,15 +587,7 @@ def log_joint(spec: PertModelSpec, params: dict, fixed: dict,
             etas_sm = state_major(etas)
         else:
             log_pi = c["log_pi"]
-            # parenthesisation matters: the two gammaln terms are ~1.3e7 at
-            # the default 1e6 concentrations and cancel to ~1e2 — adding the
-            # small data term BEFORE the cancellation would absorb it into
-            # f32 rounding (spacing is 1.0 at that magnitude, ~1 per bin)
-            lp_pi = (
-                jnp.sum((etas - 1.0) * log_pi, axis=-1)
-                + (gammaln(jnp.sum(etas, axis=-1))
-                   - jnp.sum(gammaln(etas), axis=-1))
-            )
+            lp_pi = _dirichlet_pi_term(spec.P, batch, log_pi, sparse=False)
             pi_like = log_pi
     lp += jnp.sum(lp_pi * mask[:, None] * lmask[None, :])
 
@@ -649,6 +671,45 @@ def pert_loss(spec: PertModelSpec, params: dict, fixed: dict,
     ``mesh`` (optional) routes the enumerated likelihood through
     shard_map over the mesh's cells axis — see ``_enum_bin_loglik``."""
     return -log_joint(spec, params, fixed, batch, mesh=mesh)
+
+
+def per_cell_objective(spec: PertModelSpec, params: dict, fixed: dict,
+                       batch: PertBatch) -> jnp.ndarray:
+    """(cells,) per-cell terms of the log-joint: enumerated bin
+    log-likelihood + Dirichlet pi data term + tau/u/betas priors, each
+    summed over (masked) loci.  Global priors (a, beta_means) are
+    EXCLUDED — they are identical for any two parameter sets that share
+    the conditioned globals, which is exactly the mirror-rescue use case
+    (infer/runner.py): rank two candidate fits of the SAME cells cell by
+    cell.  Uses the XLA enumeration path (rescue batches are small);
+    decomposes the same terms ``log_joint`` sums, so an accepted rescue
+    can only increase the total objective.
+    """
+    c = constrained(spec, params, fixed)
+    lamb, log_lamb, log1m_lamb = _nb_pieces(c)
+    num_loci = batch.reads.shape[1]
+    lmask = batch.effective_loci_mask()
+
+    reads_mean = _loci_mean(batch.reads, lmask)
+    ploidies = _cell_ploidies(spec, batch)
+    obj = _per_cell_log_prior(spec, c, batch, reads_mean, ploidies)
+
+    log_pi = c["log_pi"]
+    lp_pi = _dirichlet_pi_term(spec.P, batch, log_pi,
+                               sparse=batch.eta_idx is not None)
+    obj += jnp.sum(lp_pi * lmask[None, :], axis=1)
+
+    phi = _phi(c, num_loci)
+    omega = gc_rate(c["betas"], batch.gamma_feats)
+    if spec.step1:
+        ll = _observed_bin_loglik(spec, batch.reads, c["u"], omega, log_pi,
+                                  phi, batch.cn_obs, batch.rep_obs, lamb,
+                                  log_lamb, log1m_lamb)
+    else:
+        joint = _joint_logits(spec.P, batch.reads, c["u"], omega, log_pi,
+                              phi, lamb, log_lamb, log1m_lamb)
+        ll = logsumexp(joint, axis=(-2, -1))
+    return obj + jnp.sum(ll * lmask[None, :], axis=1)
 
 
 # ---------------------------------------------------------------------------

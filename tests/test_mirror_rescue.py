@@ -1,0 +1,180 @@
+"""Mirror-basin rescue (PertConfig.mirror_rescue) — beyond-reference.
+
+PERT's step-2 objective is mirror-degenerate at the S-phase extremes: a
+nearly-fully-replicated cell at read rate u is likelihood-equivalent to
+an unreplicated cell at rate ~2u, and the u prior's mean tracks the
+fitted tau (reference: pert_model.py:597-600), so both basins are
+self-consistent for the reference's prior-free ``expose_tau`` param
+(reference: pert_model.py:583).  The rescue re-fits boundary-tau cells
+from the mirrored initialisation and keeps, per cell, whichever fit
+scores the higher per-cell log-joint.
+
+These tests drive the mechanism deterministically: corrupt a fitted
+step-2 state into the mirrored basin for chosen late-S cells and assert
+the rescue (a) detects them, (b) restores a high-tau fit, (c) strictly
+improves the total objective; and that on an uncorrupted state the pass
+never degrades the objective.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from scdna_replication_tools_tpu.config import ColumnConfig, PertConfig
+from scdna_replication_tools_tpu.data.loader import build_pert_inputs
+from scdna_replication_tools_tpu.infer.runner import PertInference
+from scdna_replication_tools_tpu.models.pert import (
+    constrained,
+    from_unit_interval,
+    pert_loss,
+)
+
+# 24 S cells: indices 0-5 late S (the mirror-prone regime), rest spread
+TAUS = np.concatenate([
+    np.linspace(0.90, 0.96, 6),
+    np.linspace(0.15, 0.80, 18),
+])
+
+
+def _workload(synthetic_frames):
+    """PERT-generative reads over the conftest frames with engineered
+    per-cell taus (the conftest Poisson draws carry no replication
+    structure, so tau would be unidentifiable)."""
+    df_s, df_g = (df.copy() for df in synthetic_frames)
+    rng = np.random.default_rng(5)
+    lamb, a_true, u_true = 0.75, 10.0, 12.0
+
+    def fill(df, s_phase):
+        reads = np.empty(len(df), float)
+        tau_map = {}
+        for i, cid in enumerate(df["cell_id"].drop_duplicates()):
+            m = (df["cell_id"] == cid).to_numpy()
+            sub = df[m]
+            clone = sub["clone_id"].iloc[0]
+            rt = sub["rt_A" if clone == "A" else "rt_B"].to_numpy()
+            cn = sub["true_somatic_cn"].to_numpy()
+            gc = sub["gc"].to_numpy()
+            tau = float(TAUS[i]) if s_phase else 0.0
+            if s_phase:
+                phi = 1.0 / (1.0 + np.exp(-a_true * (tau - (1.0 - rt))))
+                rep = (rng.random(rt.size) < phi).astype(float)
+            else:
+                rep = np.zeros(rt.size)
+            theta = u_true * cn * (1.0 + rep) * np.exp(0.5 * gc)
+            delta = np.maximum(theta * (1.0 - lamb) / lamb, 1.0)
+            reads[m] = rng.negative_binomial(delta, 1.0 - lamb)
+            tau_map[cid] = tau
+        df["reads"] = reads
+        df["state"] = df["true_somatic_cn"].astype(int)
+        return tau_map
+
+    tau_map = fill(df_s, True)
+    fill(df_g, False)
+    s, g1 = build_pert_inputs(df_s, df_g, ColumnConfig())
+    true_t = np.array([tau_map[c] for c in s.cell_ids])
+    clone_of = df_s.drop_duplicates("cell_id").set_index("cell_id")[
+        "clone_id"]
+    clone_idx = np.array([0 if clone_of[c] == "A" else 1
+                          for c in s.cell_ids], np.int32)
+    return s, g1, true_t, clone_idx
+
+
+@pytest.fixture(scope="module")
+def fitted(synthetic_frames):
+    s, g1, true_t, clone_idx = _workload(synthetic_frames)
+    cfg = PertConfig(max_iter=250, min_iter=60, max_iter_step1=100,
+                     min_iter_step1=30, run_step3=False,
+                     cn_prior_method="g1_clones", enum_impl="xla",
+                     mirror_max_iter=300, mirror_min_iter=50)
+    inf = PertInference(s, g1, cfg, clone_idx_s=clone_idx,
+                        clone_idx_g1=clone_idx, num_clones=2)
+    step1 = inf.run_step1()
+    etas = inf.build_etas()
+    step2 = inf.run_step2(step1, etas)
+    return inf, step2, true_t
+
+
+def _corrupt_to_mirror(step2, cells):
+    """Move the given cells' params into the mirrored basin: tau -> 0.01
+    with u scaled to keep the expected read rate (the degeneracy's other
+    self-consistent solution)."""
+    params = {k: np.array(v) for k, v in step2.fit.params.items()}
+    c = constrained(step2.spec, step2.fit.params, step2.fixed)
+    tau_fit = np.asarray(c["tau"])
+    for i in cells:
+        params["tau_raw"][i] = from_unit_interval(0.01)
+        params["u"][i] = params["u"][i] * (1.0 + tau_fit[i]) / 1.01
+    import jax.numpy as jnp
+    new_params = {k: jnp.asarray(v) for k, v in params.items()}
+    return dataclasses.replace(
+        step2, fit=dataclasses.replace(step2.fit, params=new_params))
+
+
+def test_rescue_restores_mirrored_cells(fitted):
+    inf, step2, true_t = fitted
+    # pick by TRUTH, not position: the loader orders cells
+    # lexicographically, so TAUS' positional order is not preserved
+    late = list(np.flatnonzero(true_t > 0.85))[:3]
+    assert len(late) == 3
+    corrupted = _corrupt_to_mirror(step2, late)
+
+    loss_before = float(pert_loss(corrupted.spec, corrupted.fit.params,
+                                  corrupted.fixed, corrupted.batch))
+    rescued = inf._mirror_rescue(corrupted, corrupted.batch)
+
+    assert inf.mirror_rescue_stats["candidates"] >= len(late)
+    assert inf.mirror_rescue_stats["accepted"] >= len(late)
+
+    c = constrained(rescued.spec, rescued.fit.params, rescued.fixed)
+    tau = np.asarray(c["tau"])
+    for i in late:
+        assert tau[i] > 0.5, (
+            f"cell {i} stayed mirrored: tau={tau[i]:.3f} "
+            f"(true {true_t[i]:.2f})")
+
+    loss_after = float(pert_loss(rescued.spec, rescued.fit.params,
+                                 rescued.fixed, rescued.batch))
+    assert loss_after < loss_before, (loss_after, loss_before)
+
+
+def test_rescue_candidate_cap(fitted):
+    """mirror_max_cells bounds the sub-fit, most boundary-extreme first."""
+    inf, step2, true_t = fitted
+    late = list(np.flatnonzero(true_t > 0.85))[:3]
+    corrupted = _corrupt_to_mirror(step2, late)
+    old_cfg = inf.config
+    try:
+        inf.config = dataclasses.replace(old_cfg, mirror_max_cells=1)
+        rescued = inf._mirror_rescue(corrupted, corrupted.batch)
+    finally:
+        inf.config = old_cfg
+    assert inf.mirror_rescue_stats["candidates"] >= len(late)
+    assert inf.mirror_rescue_stats["capped_to"] == 1
+    assert inf.mirror_rescue_stats["accepted"] <= 1
+    # the one rescued cell is one of the corrupted (most extreme) ones
+    c = constrained(rescued.spec, rescued.fit.params, rescued.fixed)
+    tau = np.asarray(c["tau"])
+    assert sum(tau[i] > 0.5 for i in late) == \
+        inf.mirror_rescue_stats["accepted"]
+
+
+def test_rescue_never_degrades_clean_fit(fitted):
+    inf, step2, _ = fitted
+    loss_before = float(pert_loss(step2.spec, step2.fit.params,
+                                  step2.fixed, step2.batch))
+    rescued = inf._mirror_rescue(step2, step2.batch)
+    loss_after = float(pert_loss(rescued.spec, rescued.fit.params,
+                                 rescued.fixed, rescued.batch))
+    # per-cell acceptance: only objective-improving swaps are taken, so
+    # the total can only go down (equal when nothing is accepted); allow
+    # float32 evaluation noise
+    assert loss_after <= loss_before + abs(loss_before) * 1e-6
+
+    # non-candidate cells' params are untouched
+    c0 = constrained(step2.spec, step2.fit.params, step2.fixed)
+    c1 = constrained(rescued.spec, rescued.fit.params, rescued.fixed)
+    tau0, tau1 = np.asarray(c0["tau"]), np.asarray(c1["tau"])
+    cfg = inf.config
+    non_cand = (tau0 >= cfg.mirror_tau_lo) & (tau0 <= cfg.mirror_tau_hi)
+    np.testing.assert_array_equal(tau0[non_cand], tau1[non_cand])

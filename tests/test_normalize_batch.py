@@ -199,10 +199,13 @@ def test_batch_core_matches_single_on_many_segments():
                     reason="SCRT_SKIP_SLOW=1")
 def test_batch_cna_pass_10k_cells_genome_wide():
     """Round-2 verdict bar: 10k cells x 5,451 loci through the batched
-    CNA pass without the per-cell Python cliff.  Measured 374s on ONE
-    core (vs ~2h extrapolated for the per-cell loop); the kernel threads
-    across cores, so the bound scales with the machine: <60s on the
-    >=8-core boxes the bar was written for."""
+    CNA pass without the per-cell Python cliff.  Measured ~374 CPU-s
+    (vs ~2h extrapolated for the per-cell loop).  The bound is on
+    PROCESS CPU TIME, not wall-clock: total CPU work is what the
+    Python-cliff bar actually measures, it is invariant to how many
+    cores the threaded kernel spreads across, and — unlike wall time —
+    it does not flake when an unrelated process contends for the
+    machine (this test failed twice from exactly that)."""
     import time
 
     from scdna_replication_tools_tpu.native.build import native_available
@@ -210,7 +213,7 @@ def test_batch_cna_pass_10k_cells_genome_wide():
     if not native_available():
         pytest.skip("native kernel unavailable; the pure-Python fallback "
                     "would run this scale test for hours before failing "
-                    "the wall-clock bound")
+                    "the cpu-time bound")
 
     rng = np.random.default_rng(1)
     S, n = 10_000, 5451
@@ -221,11 +224,9 @@ def test_batch_cna_pass_10k_cells_genome_wide():
     chroms = np.array(["1"] * 2000 + ["7"] * 1500 + ["13"] * 1000
                       + ["X"] * 951, dtype=object)
     row_len = np.full(S, n, np.int64)
-    t0 = time.time()
+    t0 = time.process_time()
     rt, chng = remove_cell_specific_CNAs_batch(Y, row_len, [chroms] * S)
-    wall = time.time() - t0
-    cores = os.cpu_count() or 1
-    bound = 75.0 * max(1.0, 8.0 / cores)
-    assert wall < bound, f"{wall:.0f}s on {cores} cores (bound {bound:.0f}s)"
+    cpu_s = time.process_time() - t0
+    assert cpu_s < 600.0, f"{cpu_s:.0f} CPU-s (bound 600)"
     assert np.isfinite(rt[:, :n]).all()
     assert (chng.max(axis=1) > 0).sum() > 5_000  # the gates really fired

@@ -118,7 +118,8 @@ def run(args):
                 assign_col="copy", cn_prior_method=args.cn_prior_method,
                 max_iter=args.max_iter, min_iter=args.min_iter,
                 run_step3=args.run_step3, enum_impl=args.enum_impl,
-                num_shards=args.num_shards, loci_shards=args.loci_shards)
+                num_shards=args.num_shards, loci_shards=args.loci_shards,
+                mirror_rescue=args.mirror_rescue)
     if args.profile_dir:
         import dataclasses
         scrt.config = dataclasses.replace(scrt.config,
@@ -163,6 +164,8 @@ def run(args):
         "num_shards": args.num_shards,
         "loci_shards": args.loci_shards,
         "profile_dir": args.profile_dir,
+        "mirror_rescue": bool(args.mirror_rescue),
+        "mirror_rescue_stats": getattr(scrt, "mirror_rescue_stats", None),
     }
     print(json.dumps(out))
     if args.out:
@@ -203,10 +206,28 @@ def main(argv=None):
     ap.add_argument("--cn-prior-method", default="g1_clones")
     ap.add_argument("--enum-impl", default="auto")
     ap.add_argument("--run-step3", action="store_true")
+    ap.add_argument("--mirror-rescue", action="store_true",
+                    help="post-step-2 mirror-basin rescue for "
+                         "boundary-tau cells (beyond-reference; "
+                         "see PertConfig.mirror_rescue)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--profile-dir", default=None)
     ap.add_argument("--out", default=None)
+    ap.add_argument("--platform", default="ambient",
+                    choices=["ambient", "cpu"],
+                    help="'cpu' forces the CPU backend (the ambient "
+                         "tunneled-TPU backend hangs ~30 min before "
+                         "erroring when the tunnel is down; jax is "
+                         "pre-imported by sitecustomize, so the env var "
+                         "alone cannot do this)")
     args = ap.parse_args(argv)
+    if args.platform == "cpu":
+        import os
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     needed = args.num_shards * args.loci_shards
     if needed > 1:
         _ensure_devices(needed)
