@@ -159,6 +159,28 @@ def test_rescue_candidate_cap(fitted):
         inf.mirror_rescue_stats["accepted"]
 
 
+def test_per_cell_objective_decomposes_log_joint(fitted):
+    """sum(per_cell_objective) + global priors == log_joint — the
+    numerical foundation of the rescue acceptance rule (accepted swaps
+    can only increase the total objective)."""
+    from scdna_replication_tools_tpu.models.pert import (
+        _global_log_prior,
+        log_joint,
+        per_cell_objective,
+    )
+
+    inf, step2, _ = fitted
+    spec, params, fixed, batch = (step2.spec, step2.fit.params,
+                                  step2.fixed, step2.batch)
+    total = float(log_joint(spec, params, fixed, batch))
+    per_cell = np.asarray(per_cell_objective(spec, params, fixed, batch))
+    glob = float(_global_log_prior(spec, constrained(spec, params, fixed)))
+    # log_joint masks per-cell terms; per_cell_objective does not — apply
+    # the mask here so the identity also holds for padded batches
+    recon = float((per_cell * np.asarray(batch.mask)).sum()) + glob
+    assert abs(recon - total) <= abs(total) * 1e-5, (recon, total)
+
+
 def test_rescue_never_degrades_clean_fit(fitted):
     inf, step2, _ = fitted
     loss_before = float(pert_loss(step2.spec, step2.fit.params,
