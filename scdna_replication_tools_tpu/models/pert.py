@@ -52,6 +52,7 @@ import numpy as np
 from jax.scipy.special import gammaln, logsumexp
 
 from scdna_replication_tools_tpu.layout import (
+    CELLS_AXIS,
     cells_major,
     enum_shard_specs,
     fused_shard_specs,
@@ -728,6 +729,72 @@ def model_joint_logits(spec: PertModelSpec, params: dict, fixed: dict,
                          phi, lamb, log_lamb, log1m_lamb)
 
 
+def _per_cell_param_axes() -> dict:
+    """Per-cell param name -> the axis its cells live on, DERIVED from
+    layout.param_specs (the single owner of the tensor-layout contract):
+    a param is per-cell iff CELLS_AXIS appears in its PartitionSpec, and
+    the cells axis is that entry's position (pi_logits is state-major
+    (P, cells, loci) -> axis 1).  Params absent here (rho_raw, a_raw,
+    lamb_raw, beta_stds_raw, beta_means) are global or loci-level and
+    pass through a cell slice unchanged."""
+    from scdna_replication_tools_tpu.layout import param_specs
+
+    return {name: tuple(spec).index(CELLS_AXIS)
+            for name, spec in param_specs(None).items()
+            if CELLS_AXIS in tuple(spec)}
+
+
+_PER_CELL_PARAM_AXIS = _per_cell_param_axes()
+
+# target size of one decode slab's (chunk, loci, P, 2) joint tensor —
+# the decode is a one-shot eager pass, so slabbing costs nothing and
+# keeps packaging from OOMing at scales the fused training path handles
+# without ever materialising this tensor (10k cells x 5,451 loci x 26
+# states is 5.7 GB, several-fold more with the NB temporaries)
+_DECODE_SLAB_BYTES = 1 << 30
+
+
+def slice_cells(params: dict, batch: PertBatch, idx) -> tuple:
+    """(params, batch) restricted to the given cell indices; global and
+    loci-level entries pass through unsliced."""
+    p = {k: (jnp.take(v, idx, axis=_PER_CELL_PARAM_AXIS[k])
+             if k in _PER_CELL_PARAM_AXIS else v)
+         for k, v in params.items()}
+
+    def _take(x):
+        return None if x is None else jnp.take(x, idx, axis=0)
+
+    b = PertBatch(
+        reads=_take(batch.reads),
+        libs=_take(batch.libs),
+        gamma_feats=batch.gamma_feats,
+        mask=_take(batch.mask),
+        loci_mask=batch.loci_mask,
+        etas=_take(batch.etas),
+        eta_idx=_take(batch.eta_idx),
+        eta_w=_take(batch.eta_w),
+        cn_obs=_take(batch.cn_obs),
+        rep_obs=_take(batch.rep_obs),
+        t_alpha=_take(batch.t_alpha),
+        t_beta=_take(batch.t_beta),
+    )
+    return p, b
+
+
+def _decode_slabs(spec: PertModelSpec, batch: PertBatch,
+                  cell_chunk) -> list:
+    """Cell-index slabs for the chunked decodes.  ``cell_chunk`` None
+    sizes slabs so one joint tensor stays under _DECODE_SLAB_BYTES."""
+    num_cells, num_loci = batch.reads.shape
+    if cell_chunk is None:
+        per_cell = num_loci * spec.P * 2 * 4
+        cell_chunk = max(1, _DECODE_SLAB_BYTES // max(per_cell, 1))
+    if cell_chunk >= num_cells:
+        return [None]  # single pass, no slicing
+    return [np.arange(i, min(i + cell_chunk, num_cells))
+            for i in range(0, num_cells, cell_chunk)]
+
+
 def p_rep_marginal(joint: jnp.ndarray) -> jnp.ndarray:
     """(cells, loci) posterior marginal P(rep=1 | reads) from the joint
     logits — a capability the reference's temperature-0 decode does not
@@ -739,7 +806,7 @@ def p_rep_marginal(joint: jnp.ndarray) -> jnp.ndarray:
 
 
 def decode_discrete(spec: PertModelSpec, params: dict, fixed: dict,
-                    batch: PertBatch):
+                    batch: PertBatch, cell_chunk: Optional[int] = None):
     """MAP cn/rep per bin + marginal replication probability.
 
     Equivalent to ``infer_discrete(temperature=0)`` on the trained model
@@ -748,19 +815,35 @@ def decode_discrete(spec: PertModelSpec, params: dict, fixed: dict,
     code, reference: pert_model.py:260-269), the joint MAP factorises into
     an independent argmax over the (P, 2) logits of each bin.
 
+    The decode is evaluated in cell slabs (every term is per-cell
+    independent, so slabbing is exact): ``cell_chunk`` None auto-sizes
+    slabs to keep each (chunk, loci, P, 2) joint tensor under
+    ~_DECODE_SLAB_BYTES — without this, packaging a 10k-cell fit would
+    materialise the very enumeration tensor the fused training kernel
+    exists to avoid.
+
     Returns (cn_map, rep_map, p_rep) each (cells, loci).
     """
-    joint = model_joint_logits(spec, params, fixed, batch)
-    flat = joint.reshape(joint.shape[:-2] + (spec.P * 2,))
-    best = jnp.argmax(flat, axis=-1)
-    cn_map = (best // 2).astype(jnp.int32)
-    rep_map = (best % 2).astype(jnp.int32)
-    return cn_map, rep_map, p_rep_marginal(joint)
+    outs = []
+    for idx in _decode_slabs(spec, batch, cell_chunk):
+        p, b = (params, batch) if idx is None \
+            else slice_cells(params, batch, idx)
+        joint = model_joint_logits(spec, p, fixed, b)
+        flat = joint.reshape(joint.shape[:-2] + (spec.P * 2,))
+        best = jnp.argmax(flat, axis=-1)
+        outs.append(((best // 2).astype(jnp.int32),
+                     (best % 2).astype(jnp.int32),
+                     p_rep_marginal(joint)))
+    if len(outs) == 1:
+        return outs[0]
+    return tuple(jnp.concatenate([o[i] for o in outs], axis=0)
+                 for i in range(3))
 
 
 def decode_discrete_hmm(spec: PertModelSpec, params: dict, fixed: dict,
                         batch: PertBatch, restart: jnp.ndarray,
-                        self_prob: float):
+                        self_prob: float,
+                        cell_chunk: Optional[int] = None):
     """Genome-smoothed MAP decode: Viterbi over the CN chain.
 
     Opt-in alternative to :func:`decode_discrete` that couples adjacent
@@ -768,8 +851,19 @@ def decode_discrete_hmm(spec: PertModelSpec, params: dict, fixed: dict,
     stand-in inspired by the machinery the reference defined but never
     used, pert_model.py:260-269) — see ``models.hmm``.  ``restart``
     is a (loci,) float array with 1.0 wherever a new chromosome starts.
+
+    Cell-slabbed like :func:`decode_discrete` (the Viterbi couples LOCI,
+    not cells, so slabbing the cells axis is exact).
     """
     from scdna_replication_tools_tpu.models.hmm import hmm_decode
 
-    joint = model_joint_logits(spec, params, fixed, batch)
-    return hmm_decode(joint, restart, self_prob)
+    outs = []
+    for idx in _decode_slabs(spec, batch, cell_chunk):
+        p, b = (params, batch) if idx is None \
+            else slice_cells(params, batch, idx)
+        joint = model_joint_logits(spec, p, fixed, b)
+        outs.append(hmm_decode(joint, restart, self_prob))
+    if len(outs) == 1:
+        return outs[0]
+    return tuple(jnp.concatenate([o[i] for o in outs], axis=0)
+                 for i in range(len(outs[0])))
