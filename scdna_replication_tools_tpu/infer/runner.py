@@ -440,6 +440,11 @@ class PertInference:
         (models.pert.per_cell_objective).  Per-cell selection makes the
         pass strictly objective-improving; a beyond-reference capability,
         default off.
+
+        Step 2 only, by design: in step 3 the population is G1/2 cells,
+        for which tau ~ 0 is the CORRECT fit — boundary tau is the norm
+        there, not a degeneracy symptom, and a rescue pass would re-fit
+        (and reject) most of the cohort for nothing.
         """
         cfg = self.config
         # candidate scan from tau_raw alone — constrained() would also
