@@ -417,7 +417,31 @@ class PertInference:
         self._step2_data = s
         if self.config.mirror_rescue:
             out = self._mirror_rescue(out, batch)
+        else:
+            # reference-faithful path: no behaviour change, but surface
+            # the symptom the opt-in rescue exists for
+            cfg = self.config
+            _, cand = self._mirror_candidates(out, batch)
+            if cand.size:
+                profiling.logger.info(
+                    "step 2: %d cells fitted at boundary tau (outside "
+                    "[%.2f, %.2f]) — if their profiles look fully "
+                    "replicated this may be the tau mirror degeneracy; "
+                    "consider mirror_rescue=True",
+                    cand.size, cfg.mirror_tau_lo, cfg.mirror_tau_hi)
         return out
+
+    def _mirror_candidates(self, out: StepOutput, batch: PertBatch):
+        """(tau, candidate indices) — the boundary-tau cells the rescue
+        would process; shared by the rescue and the no-rescue hint so
+        the hint can never report a different cell set.  Reads tau from
+        tau_raw alone (constrained() would also materialise log_pi/pi)."""
+        cfg = self.config
+        tau = np.asarray(to_unit_interval(out.fit.params["tau_raw"]))
+        mask = np.asarray(batch.mask)
+        cand = np.flatnonzero(((tau < cfg.mirror_tau_lo)
+                               | (tau > cfg.mirror_tau_hi)) & (mask > 0.5))
+        return tau, cand
 
     def _mirror_rescue(self, out: StepOutput, batch: PertBatch) -> StepOutput:
         """Post-step-2 mirror-basin rescue (``PertConfig.mirror_rescue``).
@@ -447,14 +471,7 @@ class PertInference:
         (and reject) most of the cohort for nothing.
         """
         cfg = self.config
-        # candidate scan from tau_raw alone — constrained() would also
-        # materialise log_pi AND pi, two (P, cells, loci) tensors the
-        # fused training path deliberately never builds (GBs at genome
-        # scale), just to read three cheap sites
-        tau = np.asarray(to_unit_interval(out.fit.params["tau_raw"]))
-        mask = np.asarray(batch.mask)
-        cand = np.flatnonzero(((tau < cfg.mirror_tau_lo)
-                               | (tau > cfg.mirror_tau_hi)) & (mask > 0.5))
+        tau, cand = self._mirror_candidates(out, batch)
         self.mirror_rescue_stats = {"candidates": int(cand.size),
                                     "accepted": 0}
         if cand.size == 0:
