@@ -11,16 +11,35 @@ synthetic dataset (no bundled data files needed).
         [--cells-per-clone 20] [--max-iter 400] [--loci 150]
 
 On CPU this takes ~2-4 minutes; on TPU the SVI steps compile once and run
-in seconds.
+in seconds.  Set ``SCRT_TUTORIAL_CPU=1`` to force the CPU backend (an
+env var rather than a flag because it must land before jax initialises
+the ambient accelerator backend — a tunneled TPU whose tunnel is down
+hangs for ~30 minutes before erroring).
 """
 
 from __future__ import annotations
 
 import argparse
 import os
+import pathlib
+import sys
 
 import numpy as np
 import pandas as pd
+
+# make the repo-root package importable when invoked as a script, without
+# requiring PYTHONPATH (which can shadow the environment's sitecustomize
+# and break ambient accelerator-backend registration)
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+if os.environ.get("SCRT_TUTORIAL_CPU") == "1":
+    # opt-out of the ambient accelerator backend (a tunneled TPU whose
+    # tunnel is down hangs ~30 min before erroring); jax may already be
+    # imported by sitecustomize, so override the live config too
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 
 def make_input_frames(num_loci=150, cells_per_clone=20, seed=7):
