@@ -166,7 +166,8 @@ class scRT:
         cn_s_out, supp_s_out = package_step_output(
             self.cn_s, inference._step2_data, step2, lamb,
             step1.fit.losses, step2.fit.losses, cols,
-            hmm_self_prob=self.config.cn_hmm_self_prob)
+            hmm_self_prob=self.config.cn_hmm_self_prob,
+            mirror_rescue_stats=inference.mirror_rescue_stats)
 
         if step3 is not None:
             cn_g1_out, supp_g1_out = package_step_output(

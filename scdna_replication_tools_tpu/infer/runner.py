@@ -469,6 +469,11 @@ class PertInference:
         for which tau ~ 0 is the CORRECT fit — boundary tau is the norm
         there, not a degeneracy symptom, and a rescue pass would re-fit
         (and reject) most of the cohort for nothing.
+
+        Checkpoint interplay: the step-2 checkpoint stores the
+        PRE-rescue params (saved inside _fit), so a resume from a
+        completed step-2 checkpoint re-runs the rescue — deterministic,
+        and costs one sub-fit compile.
         """
         cfg = self.config
         tau, cand = self._mirror_candidates(out, batch)
@@ -635,6 +640,7 @@ def package_step_output(
     losses_s: np.ndarray,
     cols: ColumnConfig = ColumnConfig(),
     hmm_self_prob: Optional[float] = None,
+    mirror_rescue_stats: Optional[dict] = None,
 ) -> Tuple[pd.DataFrame, pd.DataFrame]:
     """Decode discretes + melt fitted values back to the long-form contract.
 
@@ -705,4 +711,12 @@ def package_step_output(
                       "level": np.arange(len(losses_s)),
                       "value": np.asarray(losses_s, np.float64)}),
     ]
+    if mirror_rescue_stats is not None:
+        # audit trail in the user-facing output, not just logs: how many
+        # boundary-tau cells the rescue examined and how many it kept
+        supp.append(pd.DataFrame({
+            "param": [f"mirror_rescue_{k}" for k in mirror_rescue_stats],
+            "level": ["all"] * len(mirror_rescue_stats),
+            "value": [float(v) for v in mirror_rescue_stats.values()],
+        }))
     return out, pd.concat(supp, ignore_index=True)
