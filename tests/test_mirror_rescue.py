@@ -80,19 +80,26 @@ def _workload(synthetic_frames):
     return s, g1, true_t, clone_idx
 
 
-@pytest.fixture(scope="module")
-def fitted(synthetic_frames):
+def _fit_pipeline(synthetic_frames, **cfg_overrides):
+    """steps 1-2 on the engineered-tau workload; kwargs override config."""
     s, g1, true_t, clone_idx = _workload(synthetic_frames)
-    cfg = PertConfig(max_iter=250, min_iter=60, max_iter_step1=100,
-                     min_iter_step1=30, run_step3=False,
-                     cn_prior_method="g1_clones", enum_impl="xla",
-                     mirror_max_iter=300, mirror_min_iter=50)
-    inf = PertInference(s, g1, cfg, clone_idx_s=clone_idx,
-                        clone_idx_g1=clone_idx, num_clones=2)
+    cfg_kwargs = dict(max_iter=250, min_iter=60, max_iter_step1=100,
+                      min_iter_step1=30, run_step3=False,
+                      cn_prior_method="g1_clones", enum_impl="xla",
+                      mirror_max_iter=300, mirror_min_iter=50)
+    cfg_kwargs.update(cfg_overrides)
+    inf = PertInference(s, g1, PertConfig(**cfg_kwargs),
+                        clone_idx_s=clone_idx, clone_idx_g1=clone_idx,
+                        num_clones=2)
     step1 = inf.run_step1()
     etas = inf.build_etas()
     step2 = inf.run_step2(step1, etas)
     return inf, step2, true_t
+
+
+@pytest.fixture(scope="module")
+def fitted(synthetic_frames):
+    return _fit_pipeline(synthetic_frames)
 
 
 def _corrupt_to_mirror(step2, cells):
@@ -179,6 +186,24 @@ def test_per_cell_objective_decomposes_log_joint(fitted):
     # the mask here so the identity also holds for padded batches
     recon = float((per_cell * np.asarray(batch.mask)).sum()) + glob
     assert abs(recon - total) <= abs(total) * 1e-5, (recon, total)
+
+
+def test_rescue_on_sharded_step2(synthetic_frames):
+    """The rescue must work when step 2 ran on a device mesh: sharded
+    params/batch materialise host-side for the candidate scan and the
+    splice, and the sub-fit runs single-device."""
+    inf, step2, true_t = _fit_pipeline(
+        synthetic_frames, max_iter=150, min_iter=40, max_iter_step1=60,
+        min_iter_step1=20, num_shards=2, mirror_max_iter=200,
+        mirror_min_iter=40)
+    assert not step2.batch.reads.sharding.is_fully_replicated
+
+    late = [int(np.flatnonzero(true_t > 0.85)[0])]
+    corrupted = _corrupt_to_mirror(step2, late)
+    rescued = inf._mirror_rescue(corrupted, corrupted.batch)
+    assert inf.mirror_rescue_stats["accepted"] >= 1
+    c = constrained(rescued.spec, rescued.fit.params, rescued.fixed)
+    assert float(np.asarray(c["tau"])[late[0]]) > 0.5
 
 
 def test_rescue_never_degrades_clean_fit(fitted):
