@@ -335,6 +335,13 @@ def _run(args, platform, probe_attempts=None):
                                           args.K, args.baseline_iters)
         vs = cpu_per_iter / jax_per_iter
 
+    # measured, not the forced/probed label: --platform tpu with a dead
+    # tunnel can silently downgrade to CPU with only a jax warning, and
+    # the label would still read "tpu" — consumers (tpu_window_runner)
+    # gate on this field instead
+    import jax
+    device_platform = jax.devices()[0].platform
+
     print(json.dumps({
         "metric": "pert_step2_svi_cells_per_sec",
         "value": round(cells_per_sec, 1),
@@ -342,6 +349,7 @@ def _run(args, platform, probe_attempts=None):
                 f"enumerated SVI step)",
         "vs_baseline": None if vs is None else round(vs, 2),
         "platform": platform,
+        "device_platform": device_platform,
         # enum_impl round-trips into PertConfig.enum_impl; the sparse
         # winner is the same kernel with PertConfig.sparse_etas=True
         "enum_impl": "pallas" if winner == "pallas_sparse" else winner,

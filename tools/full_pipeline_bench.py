@@ -119,6 +119,7 @@ def run(args):
                 max_iter=args.max_iter, min_iter=args.min_iter,
                 run_step3=args.run_step3, enum_impl=args.enum_impl,
                 num_shards=args.num_shards, loci_shards=args.loci_shards,
+                cell_chunk=args.cell_chunk,
                 mirror_rescue=args.mirror_rescue)
     if args.profile_dir:
         import dataclasses
@@ -163,6 +164,7 @@ def run(args):
         "bin_size": args.bin_size,
         "num_shards": args.num_shards,
         "loci_shards": args.loci_shards,
+        "cell_chunk": args.cell_chunk,
         "profile_dir": args.profile_dir,
         "mirror_rescue": bool(args.mirror_rescue),
         "mirror_rescue_stats": getattr(scrt, "mirror_rescue_stats", None),
@@ -201,6 +203,10 @@ def main(argv=None):
     ap.add_argument("--loci-shards", type=int, default=1,
                     help="2-D (cells x loci) mesh for the long-genome "
                          "regime; total devices = num_shards * loci_shards")
+    ap.add_argument("--cell-chunk", type=int, default=None,
+                    help="cells per lax.scan chunk inside the loss "
+                         "(PertConfig.cell_chunk) — HBM fallback for "
+                         "10k-cell single-chip runs")
     ap.add_argument("--max-iter", type=int, default=800)
     ap.add_argument("--min-iter", type=int, default=100)
     ap.add_argument("--cn-prior-method", default="g1_clones")

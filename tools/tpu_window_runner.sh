@@ -1,0 +1,117 @@
+#!/bin/bash
+# Watch for a TPU tunnel window and run the queued round-5 measurements
+# the moment one opens.  The tunnel drops for hours at a time (see
+# artifacts/TPU_PROBE_r05.log); a hung backend call blocks forever with
+# ~0 CPU, so every step runs under a hard timeout and the probe gates
+# each attempt.  Artifacts land in artifacts/; progress is appended to
+# artifacts/TPU_PROBE_r05.log.
+#
+# Battery (in value order; each is skipped once its artifact exists):
+#   1. 300-iter kernel A/B (sparse/dense/xla) — noise-tight ms/iter
+#   2. 10k-cell step-2 bench — the bandwidth-bound regime
+#   3. full pipeline w/ mirror rescue on TPU — perf + accuracy headline
+#   4. 5k-cell full pipeline — scale evidence beyond the 1k artifact
+#   5. 10k-cell full pipeline (cell_chunk for HBM) — best effort,
+#      capped at MAX_10K_TRIES so it cannot pin the runner forever
+set -u
+cd "$(dirname "$0")/.."
+LOG=artifacts/TPU_PROBE_r05.log
+MAX_10K_TRIES=3
+tries_10k=0
+stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
+
+probe() {
+    local out rc
+    out=$(timeout 120 python -c \
+        "import jax; assert jax.devices()[0].platform == 'tpu'" 2>&1)
+    rc=$?
+    [ $rc -eq 0 ] && return 0
+    # distinguish a tunnel hang (rc=124 timeout) from a code/backend
+    # error (anything else, with stderr) — round 4's silent downgrade
+    # was indistinguishable from a code regression
+    echo "$(stamp) window-runner: probe fail rc=${rc}: $(echo "$out" | tail -c 160 | tr '\n' ' ')" >> "$LOG"
+    return 1
+}
+
+run_one() {  # run_one <name> <tpu_field> <timeout_s> <cmd...>
+    # tpu_field: which JSON field must prove the run was on-chip —
+    #   device_platform  for bench.py (its "platform" echoes the forced
+    #                    label even after a silent jax CPU downgrade)
+    #   platform         for full_pipeline_bench (measured at runtime)
+    local name=$1 tpu_field=$2 tmo=$3 rc; shift 3
+    if [ -s "artifacts/${name}.json" ]; then      # already landed...
+        if grep -q "\"${tpu_field}\": \"tpu\"" "artifacts/${name}.json"; then
+            return 0
+        fi
+        # ...but not on-chip (e.g. a manual dead-tunnel run): re-run it
+        mv "artifacts/${name}.json" "artifacts/${name}.cpu_fallback.json"
+        echo "$(stamp) window-runner: ${name} pre-existing artifact is not on-chip - kept aside, re-running" >> "$LOG"
+    fi
+    echo "$(stamp) window-runner: starting ${name}" >> "$LOG"
+    timeout "$tmo" "$@" \
+        > "artifacts/${name}.json.tmp" 2> "artifacts/${name}.err"
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        rm -f "artifacts/${name}.json.tmp"
+        echo "$(stamp) window-runner: ${name} failed/timeout rc=${rc}: $(tail -c 200 artifacts/${name}.err | tr '\n' ' ')" >> "$LOG"
+        return 1
+    fi
+    # full_pipeline_bench writes --out itself; bench.py emits the JSON
+    # as its last stdout line (keep only that line, defensively)
+    [ -s "artifacts/${name}.json" ] \
+        || tail -n 1 "artifacts/${name}.json.tmp" > "artifacts/${name}.json"
+    rm -f "artifacts/${name}.json.tmp"
+    # a cpu artifact must not satisfy a TPU-named step (bench.py re-execs
+    # itself on CPU when the tunnel dies mid-run and still exits 0)
+    if ! grep -q "\"${tpu_field}\": \"tpu\"" "artifacts/${name}.json"; then
+        mv "artifacts/${name}.json" "artifacts/${name}.cpu_fallback.json"
+        echo "$(stamp) window-runner: ${name} landed as CPU fallback (tunnel died mid-run?) - kept aside, will retry" >> "$LOG"
+        return 1
+    fi
+    echo "$(stamp) window-runner: ${name} OK: $(head -c 400 artifacts/${name}.json)" >> "$LOG"
+    return 0
+}
+
+battery() {  # returns 0 only if every step it attempted succeeded
+    run_one BENCH_r05_tpu_300iter device_platform 900 \
+        python bench.py --platform tpu --iters 300 --skip-baseline || return 1
+    run_one BENCH_r05_tpu_10k device_platform 1200 \
+        python bench.py --platform tpu --cells 10000 --iters 50 --skip-baseline || return 1
+    run_one FULL_PIPELINE_r05_rescue_tpu platform 1500 \
+        python tools/full_pipeline_bench.py --run-step3 --mirror-rescue \
+            --out artifacts/FULL_PIPELINE_r05_rescue_tpu.json || return 1
+    run_one FULL_PIPELINE_r05_5k_tpu platform 3600 \
+        python tools/full_pipeline_bench.py --cells 5000 --g1-cells 500 \
+            --run-step3 --mirror-rescue \
+            --out artifacts/FULL_PIPELINE_r05_5k_tpu.json || return 1
+    if [ ! -s artifacts/FULL_PIPELINE_r05_10k_tpu.json ] \
+            && [ "$tries_10k" -lt "$MAX_10K_TRIES" ]; then
+        tries_10k=$((tries_10k + 1))
+        run_one FULL_PIPELINE_r05_10k_tpu platform 7200 \
+            python tools/full_pipeline_bench.py --cells 10000 --g1-cells 1000 \
+                --run-step3 --mirror-rescue --cell-chunk 2500 \
+                --out artifacts/FULL_PIPELINE_r05_10k_tpu.json || return 1
+    fi
+    return 0
+}
+
+core_done() {
+    [ -s artifacts/BENCH_r05_tpu_300iter.json ] \
+        && [ -s artifacts/BENCH_r05_tpu_10k.json ] \
+        && [ -s artifacts/FULL_PIPELINE_r05_rescue_tpu.json ] \
+        && [ -s artifacts/FULL_PIPELINE_r05_5k_tpu.json ]
+}
+
+for attempt in $(seq 1 200); do
+    if probe; then
+        echo "$(stamp) window-runner: probe ok (attempt ${attempt}) - running battery" >> "$LOG"
+        battery || true   # a failed step still falls through to sleep
+        if core_done && { [ -s artifacts/FULL_PIPELINE_r05_10k_tpu.json ] \
+                          || [ "$tries_10k" -ge "$MAX_10K_TRIES" ]; }; then
+            echo "$(stamp) window-runner: battery complete (10k tries=${tries_10k})" >> "$LOG"
+            exit 0
+        fi
+    fi
+    sleep 300
+done
+echo "$(stamp) window-runner: gave up after 200 attempts" >> "$LOG"
