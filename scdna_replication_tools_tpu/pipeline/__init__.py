@@ -4,7 +4,11 @@ from scdna_replication_tools_tpu.pipeline.consensus import (
     filter_ploidies,
 )
 from scdna_replication_tools_tpu.pipeline.assign import assign_s_to_clones
-from scdna_replication_tools_tpu.pipeline.clustering import kmeans_cluster
+from scdna_replication_tools_tpu.pipeline.clustering import (
+    kmeans_cluster,
+    spectral_embed,
+    umap_hdbscan_cluster,
+)
 
 __all__ = [
     "add_cell_ploidies",
@@ -12,4 +16,6 @@ __all__ = [
     "filter_ploidies",
     "assign_s_to_clones",
     "kmeans_cluster",
+    "spectral_embed",
+    "umap_hdbscan_cluster",
 ]

@@ -3,8 +3,13 @@
 Mirrors ``kmeans_cluster``/``compute_bic`` (reference: cncluster.py:49-120):
 KMeans is fit for k in [min_k, max_k] and the k maximising the BIC is
 kept.  The reference's optional umap+hdbscan path (cncluster.py:10-46) is
-dead code there (never called) and is provided here as a stub that raises
-with guidance, since umap/hdbscan are not available.
+also provided (``umap_hdbscan_cluster``): the density clustering is
+sklearn's HDBSCAN with the reference's hyperparameters, and the 2-D
+embedding is a deterministic kNN-graph spectral embedding (Laplacian
+eigenmaps) standing in for UMAP — umap-learn is not bundled, and the
+spectral embedding is the same neighbor-graph family (it is UMAP's own
+initialisation), computed host-side like the rest of the pandas
+pipeline stages.
 """
 
 from __future__ import annotations
@@ -65,12 +70,96 @@ def kmeans_cluster(cn: pd.DataFrame, min_k: int = 2, max_k: int = 100
     })
 
 
-def umap_hdbscan_cluster(*args, **kwargs):
-    """Unavailable: umap/hdbscan are not bundled.
+def spectral_embed(X: np.ndarray, n_components: int = 2,
+                   n_neighbors: int = 15) -> np.ndarray:
+    """Deterministic kNN-graph spectral embedding (Laplacian eigenmaps).
 
-    The reference defines this path (cncluster.py:10-46) but never calls
-    it; ``kmeans_cluster`` is the supported clustering entry point.
+    Stands in for UMAP in ``umap_hdbscan_cluster`` (umap-learn is not
+    bundled): UMAP builds the same symmetrised-kNN graph and uses this
+    exact spectral layout as its initialisation, so for the downstream
+    purpose here — density clustering of the embedding — the spectral
+    coordinates preserve the same neighborhood structure, without the
+    stochastic refinement.
+
+    Deliberately host-only: like the reference's pandas-side clustering
+    this must work with no accelerator attached, and a device
+    round-trip here would hang forever when the ambient backend is a
+    dead TPU tunnel (observed in this environment).  Memory and time
+    stay O(n * k) + the eigensolve: the kNN edges come from sklearn's
+    NearestNeighbors (no dense n x n distance matrix), and past ~2k
+    cells the bottom eigenvectors come from ARPACK shift-invert on the
+    sparse Laplacian instead of a cubic dense ``eigh``.
     """
-    raise NotImplementedError(
-        "umap+hdbscan clustering requires the optional umap-learn and "
-        "hdbscan packages; use kmeans_cluster instead")
+    import scipy.sparse
+    import scipy.sparse.linalg
+    import sklearn.neighbors
+
+    Xd = np.asarray(X, np.float32)
+    n = Xd.shape[0]
+    k = int(min(n_neighbors, n - 1))
+
+    # kNN edges + per-point bandwidth (squared distance to the k-th
+    # neighbor), as in UMAP's local scaling; heat-kernel affinities on
+    # the kNN edges only.  Column 0 of kneighbors is the point itself.
+    dist, idx = (sklearn.neighbors.NearestNeighbors(n_neighbors=k + 1)
+                 .fit(Xd).kneighbors(Xd))
+    d2k = (dist[:, 1:] ** 2).astype(np.float64)
+    knn_idx = idx[:, 1:]
+    rows = np.repeat(np.arange(n), k)
+    cols = knn_idx.ravel()
+    sigma2 = np.maximum(d2k[:, -1], 1e-12)
+    vals = np.exp(-d2k.ravel() / np.sqrt(sigma2[rows] * sigma2[cols]))
+    w = scipy.sparse.csr_matrix((vals, (rows, cols)), shape=(n, n))
+    w = w.maximum(w.T)                          # symmetrise (fuzzy union)
+
+    # normalised Laplacian; eigenvectors 1..n_components are the layout
+    deg = np.maximum(np.asarray(w.sum(axis=1)).ravel(), 1e-12)
+    d_inv_sqrt = 1.0 / np.sqrt(deg)
+    dm = scipy.sparse.diags(d_inv_sqrt)
+    lap = scipy.sparse.identity(n, format="csr") - dm @ w @ dm
+    if n <= 2048:
+        # dense eigh: free of ARPACK convergence concerns at small n
+        _, vecs = np.linalg.eigh(lap.toarray())
+    else:
+        vals_, vecs = scipy.sparse.linalg.eigsh(
+            lap, k=n_components + 1, sigma=0.0, which="LM")
+        vecs = vecs[:, np.argsort(vals_)]   # ascending, like eigh
+    emb = vecs[:, 1:1 + n_components] * d_inv_sqrt[:, None]
+    # fix eigenvector sign for determinism across LAPACK builds
+    signs = np.sign(emb[np.argmax(np.abs(emb), axis=0),
+                        np.arange(emb.shape[1])])
+    return (emb * np.where(signs == 0, 1.0, signs)).astype(np.float32)
+
+
+def umap_hdbscan_cluster(cn: pd.DataFrame, n_components: int = 2,
+                         n_neighbors: int = 15, min_dist: float = 0.1,
+                         min_samples: int = 10, min_cluster_size: int = 30
+                         ) -> pd.DataFrame:
+    """Embed cells and density-cluster the embedding.
+
+    Parity target: the reference's ``umap_hdbscan_cluster``
+    (cncluster.py:10-46) — ``cn`` is a (loci x cells) matrix frame;
+    returns columns ``cell_id, cluster_id, umap1..umap<n>`` with
+    HDBSCAN's reference hyperparameters (min_samples=10,
+    min_cluster_size=30; exposed here so small datasets can tune them;
+    noise cells get cluster_id -1).  The embedding is the deterministic
+    spectral layout of the kNN graph (see ``spectral_embed``) rather
+    than UMAP's stochastic refinement of it; ``min_dist`` is accepted
+    for signature parity but has no spectral analogue.
+    """
+    del min_dist
+    X = cn.fillna(0).T.values
+    emb = spectral_embed(X, n_components=n_components,
+                         n_neighbors=n_neighbors)
+    clusters = sklearn.cluster.HDBSCAN(
+        min_samples=min_samples,
+        min_cluster_size=min_cluster_size,
+        # semantically a no-op for dense finite euclidean input; pinned
+        # only to silence sklearn 1.9's FutureWarning about the 1.10
+        # default change
+        copy=True,
+    ).fit_predict(emb)
+    out = pd.DataFrame({"cell_id": cn.columns, "cluster_id": clusters})
+    for j in range(emb.shape[1]):
+        out[f"umap{j + 1}"] = emb[:, j]
+    return out

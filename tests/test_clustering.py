@@ -1,0 +1,96 @@
+"""Clone-discovery clustering: kmeans/BIC and the umap_hdbscan path.
+
+The umap_hdbscan_cluster parity target is the reference's
+cncluster.py:10-46 (umap embedding -> hdbscan labels -> cell_id/
+cluster_id/umap1/umap2 frame); here the embedding is the deterministic
+kNN-graph spectral layout (see pipeline/clustering.py docstrings).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from scdna_replication_tools_tpu.pipeline.clustering import (
+    kmeans_cluster,
+    spectral_embed,
+    umap_hdbscan_cluster,
+)
+
+
+def _blob_frame(n_per_blob=40, n_loci=60, seed=0):
+    """(loci x cells) matrix frame of 3 well-separated CN blobs."""
+    rng = np.random.default_rng(seed)
+    blobs = []
+    for b, base in enumerate([2.0, 4.0, 6.0]):
+        centers = np.full(n_loci, base)
+        centers[b * 10:(b + 1) * 10] += 2.0   # blob-specific CNA
+        blobs.append(centers[None, :]
+                     + 0.1 * rng.standard_normal((n_per_blob, n_loci)))
+    X = np.concatenate(blobs, axis=0)          # cells x loci
+    cells = [f"c{b}_{i}" for b in range(3) for i in range(n_per_blob)]
+    truth = np.repeat(np.arange(3), n_per_blob)
+    frame = pd.DataFrame(X.T, columns=cells)   # loci x cells
+    return frame, truth
+
+
+def test_spectral_embed_shape_and_determinism():
+    frame, _ = _blob_frame()
+    X = frame.T.values
+    e1 = spectral_embed(X, n_components=2, n_neighbors=10)
+    e2 = spectral_embed(X, n_components=2, n_neighbors=10)
+    assert e1.shape == (X.shape[0], 2)
+    assert np.array_equal(e1, e2)
+    assert np.all(np.isfinite(e1))
+
+
+def test_umap_hdbscan_recovers_blobs():
+    frame, truth = _blob_frame()
+    out = umap_hdbscan_cluster(frame, n_neighbors=10)
+    assert list(out.columns) == ["cell_id", "cluster_id", "umap1", "umap2"]
+    assert len(out) == frame.shape[1]
+    labeled = out["cluster_id"].to_numpy()
+    # reference hyperparameters (min_cluster_size=30) on 3 x 40-cell
+    # blobs: expect the 3 blobs found with little noise
+    assert (labeled >= 0).mean() > 0.9
+    # majority label of each true blob must be distinct and dominant
+    majorities = []
+    for b in range(3):
+        lab = labeled[truth == b]
+        lab = lab[lab >= 0]
+        vals, counts = np.unique(lab, return_counts=True)
+        assert counts.max() / (truth == b).sum() > 0.8
+        majorities.append(vals[np.argmax(counts)])
+    assert len(set(majorities)) == 3
+
+
+def test_umap_hdbscan_small_data_is_noise():
+    """Below min_cluster_size everything is noise (-1), like hdbscan."""
+    frame, _ = _blob_frame(n_per_blob=8, n_loci=20)
+    out = umap_hdbscan_cluster(frame, n_neighbors=5)
+    assert (out["cluster_id"] == -1).all()
+
+
+def test_spectral_embed_sparse_branch_matches_blob_structure():
+    """n > 2048 rides the ARPACK shift-invert path; blob separation
+    must survive the solver switch."""
+    frame, truth = _blob_frame(n_per_blob=720, n_loci=30, seed=1)
+    X = frame.T.values                         # 2160 cells > 2048
+    emb = spectral_embed(X, n_components=2, n_neighbors=10)
+    assert emb.shape == (X.shape[0], 2)
+    assert np.all(np.isfinite(emb))
+    # blob centroids in embedding space must be mutually separated
+    # relative to within-blob spread
+    cents = np.stack([emb[truth == b].mean(0) for b in range(3)])
+    spread = max(emb[truth == b].std(0).max() for b in range(3))
+    for a in range(3):
+        for b in range(a + 1, 3):
+            assert np.linalg.norm(cents[a] - cents[b]) > 2.0 * spread
+
+
+def test_kmeans_cluster_still_recovers_blobs():
+    frame, truth = _blob_frame()
+    out = kmeans_cluster(frame, min_k=2, max_k=5)
+    merged = out.assign(truth=truth)
+    purity = (merged.groupby("truth")["cluster_id"]
+              .agg(lambda s: s.value_counts().iloc[0] / len(s)))
+    assert (purity > 0.9).all()
