@@ -22,7 +22,10 @@ from scdna_replication_tools_tpu.infer.runner import (
 )
 from scdna_replication_tools_tpu.models.pert import constrained
 from scdna_replication_tools_tpu.pipeline.assign import assign_s_to_clones
-from scdna_replication_tools_tpu.pipeline.clustering import kmeans_cluster
+from scdna_replication_tools_tpu.pipeline.clustering import (
+    discover_clones,
+    kmeans_cluster,
+)
 from scdna_replication_tools_tpu.pipeline.consensus import (
     compute_consensus_clone_profiles,
 )
@@ -33,7 +36,10 @@ class scRT:
 
     Mirrors ``infer_scRT.scRT`` (reference: infer_scRT.py:25-105) with the
     same keyword surface; TPU-execution extras: ``backend``, ``num_shards``,
-    ``cell_chunk``, ``checkpoint_dir``.
+    ``cell_chunk``, ``checkpoint_dir``; ``clustering_method`` selects the
+    G1 clone-discovery algorithm when ``clone_col=None`` (``'kmeans'``
+    as the reference hardwires, or ``'umap_hdbscan'`` — its optional
+    cncluster path), with ``clustering_kwargs`` forwarded to it.
     """
 
     def __init__(self, cn_s, cn_g1, input_col='reads', assign_col='copy',
@@ -52,11 +58,18 @@ class scRT:
                  run_step3=True, backend='jax', num_shards=1,
                  loci_shards=1, cell_chunk=None, checkpoint_dir=None,
                  enum_impl='auto', cn_hmm_self_prob=None,
-                 rho_from_rt_prior=False, mirror_rescue=False):
+                 rho_from_rt_prior=False, mirror_rescue=False,
+                 clustering_method='kmeans', clustering_kwargs=None):
         self.cn_s = cn_s
         self.cn_g1 = cn_g1
         self.clone_col = clone_col
         self.backend = backend
+        if clustering_method not in ('kmeans', 'umap_hdbscan'):
+            raise ValueError(
+                f"clustering_method must be 'kmeans' or 'umap_hdbscan', "
+                f"got {clustering_method!r}")
+        self.clustering_method = clustering_method
+        self.clustering_kwargs = dict(clustering_kwargs or {})
 
         self.cols = ColumnConfig(
             input_col=input_col, gc_col=gc_col, rt_prior_col=rt_prior_col,
@@ -108,16 +121,18 @@ class scRT:
     def _ensure_clones(self, assign_col: str):
         """Cluster G1 cells if no clone column, then assign S cells.
 
-        Mirrors infer_pert_model's preamble (reference: infer_scRT.py:129-148).
+        Mirrors infer_pert_model's preamble (reference: infer_scRT.py:129-148;
+        the reference hardwires kmeans — ``clustering_method='umap_hdbscan'``
+        additionally wires its optional cncluster.py:10-46 path in.  HDBSCAN
+        noise cells (cluster_id -1) are dropped from the G1 pool with a
+        warning: a noise "clone" has no meaningful consensus profile).
         """
         c = self.cols
         if self.clone_col is None:
-            g1_mat = self.cn_g1.pivot_table(
-                columns=c.cell_col, index=[c.chr_col, c.start_col],
-                values=c.assign_col, observed=True)
-            clusters = kmeans_cluster(g1_mat, max_k=20)
-            self.cn_g1 = pd.merge(self.cn_g1, clusters, on=c.cell_col)
-            self.clone_col = 'cluster_id'
+            self.cn_g1, self.clone_col = discover_clones(
+                self.cn_g1, c.assign_col, cell_col=c.cell_col,
+                chr_col=c.chr_col, start_col=c.start_col,
+                method=self.clustering_method, **self.clustering_kwargs)
 
         self.clone_profiles = compute_consensus_clone_profiles(
             self.cn_g1, assign_col, clone_col=self.clone_col,
@@ -186,7 +201,9 @@ class scRT:
             infer_cell_level,
         )
         cn_s, self.manhattan_df, self.clone_profiles, clone_col = \
-            infer_cell_level(self.cn_s, self.cn_g1, self.cols, self.clone_col)
+            infer_cell_level(self.cn_s, self.cn_g1, self.cols,
+                             self.clone_col, self.clustering_method,
+                             self.clustering_kwargs)
         self.clone_col = clone_col
         return cn_s
 
@@ -195,7 +212,9 @@ class scRT:
             infer_clone_level,
         )
         cn_s, self.manhattan_df, self.clone_profiles, clone_col = \
-            infer_clone_level(self.cn_s, self.cn_g1, self.cols, self.clone_col)
+            infer_clone_level(self.cn_s, self.cn_g1, self.cols,
+                              self.clone_col, self.clustering_method,
+                              self.clustering_kwargs)
         self.clone_col = clone_col
         return cn_s
 

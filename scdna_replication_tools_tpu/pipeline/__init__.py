@@ -5,6 +5,8 @@ from scdna_replication_tools_tpu.pipeline.consensus import (
 )
 from scdna_replication_tools_tpu.pipeline.assign import assign_s_to_clones
 from scdna_replication_tools_tpu.pipeline.clustering import (
+    cluster_g1_cells,
+    discover_clones,
     kmeans_cluster,
     spectral_embed,
     umap_hdbscan_cluster,
@@ -15,6 +17,8 @@ __all__ = [
     "compute_consensus_clone_profiles",
     "filter_ploidies",
     "assign_s_to_clones",
+    "cluster_g1_cells",
+    "discover_clones",
     "kmeans_cluster",
     "spectral_embed",
     "umap_hdbscan_cluster",

@@ -70,6 +70,59 @@ def kmeans_cluster(cn: pd.DataFrame, min_k: int = 2, max_k: int = 100
     })
 
 
+def cluster_g1_cells(g1_mat: pd.DataFrame, method: str = "kmeans",
+                     cell_col: str = "cell_id", **kwargs) -> pd.DataFrame:
+    """Clone discovery over a (loci x cells) matrix frame, by method.
+
+    The single selection point both the PERT preamble (api._ensure_clones)
+    and the deterministic levels (pipeline.deterministic) share.  Returns
+    a ``(cell_col, cluster_id)`` frame; ``kwargs`` forward to the chosen
+    clusterer.  ``umap_hdbscan`` noise cells (label -1) are dropped with
+    a warning — a noise "clone" has no meaningful consensus profile.
+    """
+    if method == "kmeans":
+        clusters = kmeans_cluster(g1_mat, **{"max_k": 20, **kwargs})
+    elif method == "umap_hdbscan":
+        clusters = umap_hdbscan_cluster(g1_mat, **kwargs)
+        noise = clusters["cluster_id"] < 0
+        if noise.any():
+            logging.warning("umap_hdbscan: dropping %d/%d G1 cells "
+                            "labelled noise", int(noise.sum()),
+                            len(clusters))
+            clusters = clusters[~noise]
+        if clusters.empty:
+            raise ValueError(
+                "umap_hdbscan labelled every G1 cell as noise; lower "
+                "min_cluster_size (clustering_kwargs) or use "
+                "clustering_method='kmeans'")
+    else:
+        raise ValueError(f"clustering method must be 'kmeans' or "
+                         f"'umap_hdbscan', got {method!r}")
+    return (clusters.rename(columns={"cell_id": cell_col})
+            [[cell_col, "cluster_id"]])
+
+
+def discover_clones(cn_g1: pd.DataFrame, value_col: str,
+                    cell_col: str = "cell_id", chr_col: str = "chr",
+                    start_col: str = "start", method: str = "kmeans",
+                    **kwargs):
+    """Full clone-discovery preamble over a long-form G1 frame.
+
+    Pivots ``cn_g1`` to a (loci x cells) matrix, clusters it via
+    ``cluster_g1_cells``, and merges the labels back; returns
+    ``(cn_g1_with_cluster_id, 'cluster_id')``.  The one implementation
+    behind both the PERT preamble (api._ensure_clones) and the
+    deterministic levels (reference: infer_scRT.py:129-148, 173-176,
+    209-212, which repeat this block inline).
+    """
+    g1_mat = cn_g1.pivot_table(columns=cell_col,
+                               index=[chr_col, start_col],
+                               values=value_col, observed=True)
+    clusters = cluster_g1_cells(g1_mat, method, cell_col=cell_col,
+                                **kwargs)
+    return pd.merge(cn_g1, clusters, on=cell_col), "cluster_id"
+
+
 def spectral_embed(X: np.ndarray, n_components: int = 2,
                    n_neighbors: int = 15) -> np.ndarray:
     """Deterministic kNN-graph spectral embedding (Laplacian eigenmaps).

@@ -11,12 +11,11 @@ from __future__ import annotations
 
 from typing import Optional, Tuple
 
-import pandas as pd
 
 from scdna_replication_tools_tpu.config import ColumnConfig
 from scdna_replication_tools_tpu.pipeline.assign import assign_s_to_clones
 from scdna_replication_tools_tpu.pipeline.binarize import binarize_profiles
-from scdna_replication_tools_tpu.pipeline.clustering import kmeans_cluster
+from scdna_replication_tools_tpu.pipeline.clustering import discover_clones
 from scdna_replication_tools_tpu.pipeline.consensus import (
     compute_consensus_clone_profiles,
 )
@@ -30,21 +29,24 @@ from scdna_replication_tools_tpu.pipeline.normalize import (
 
 
 def _cluster_if_needed(cn_s, cn_g1, cols: ColumnConfig,
-                       clone_col: Optional[str]):
+                       clone_col: Optional[str],
+                       clustering_method: str = 'kmeans',
+                       clustering_kwargs: Optional[dict] = None):
     if clone_col is None:
-        g1_mat = cn_g1.pivot_table(
-            columns=cols.cell_col, index=[cols.chr_col, cols.start_col],
-            values=cols.assign_col, observed=True)
-        clusters = kmeans_cluster(g1_mat, max_k=20)
-        cn_g1 = pd.merge(cn_g1, clusters, on=cols.cell_col)
-        clone_col = 'cluster_id'
+        cn_g1, clone_col = discover_clones(
+            cn_g1, cols.assign_col, cell_col=cols.cell_col,
+            chr_col=cols.chr_col, start_col=cols.start_col,
+            method=clustering_method, **(clustering_kwargs or {}))
     return cn_s, cn_g1, clone_col
 
 
 def infer_cell_level(cn_s, cn_g1, cols: ColumnConfig,
-                     clone_col: Optional[str]):
+                     clone_col: Optional[str],
+                     clustering_method: str = 'kmeans',
+                     clustering_kwargs: Optional[dict] = None):
     """reference: infer_scRT.py:171-204."""
-    cn_s, cn_g1, clone_col = _cluster_if_needed(cn_s, cn_g1, cols, clone_col)
+    cn_s, cn_g1, clone_col = _cluster_if_needed(
+        cn_s, cn_g1, cols, clone_col, clustering_method, clustering_kwargs)
 
     clone_profiles = compute_consensus_clone_profiles(
         cn_g1, cols.assign_col, clone_col=clone_col, cell_col=cols.cell_col,
@@ -75,9 +77,12 @@ def infer_cell_level(cn_s, cn_g1, cols: ColumnConfig,
 
 
 def infer_clone_level(cn_s, cn_g1, cols: ColumnConfig,
-                      clone_col: Optional[str]):
+                      clone_col: Optional[str],
+                      clustering_method: str = 'kmeans',
+                      clustering_kwargs: Optional[dict] = None):
     """reference: infer_scRT.py:207-242."""
-    cn_s, cn_g1, clone_col = _cluster_if_needed(cn_s, cn_g1, cols, clone_col)
+    cn_s, cn_g1, clone_col = _cluster_if_needed(
+        cn_s, cn_g1, cols, clone_col, clustering_method, clustering_kwargs)
 
     clone_profiles = compute_consensus_clone_profiles(
         cn_g1, cols.assign_col, clone_col=clone_col, cell_col=cols.cell_col,

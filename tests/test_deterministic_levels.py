@@ -91,6 +91,31 @@ def test_cell_level_clusters_when_no_clones(sim_data):
         assert col in out.columns
 
 
+def test_clone_level_clusters_umap_hdbscan(sim_data):
+    """clustering_method='umap_hdbscan' wires the reference's optional
+    cncluster.py:10-46 path into clone discovery; hyperparameters are
+    tuned down for the 24-cell fixture via clustering_kwargs."""
+    sim_s, sim_g = sim_data
+    scrt = scRT(sim_s.copy(), sim_g.copy(), input_col="reads",
+                clone_col=None, assign_col="copy", rt_prior_col=None,
+                clustering_method="umap_hdbscan",
+                clustering_kwargs={"min_cluster_size": 8, "min_samples": 4,
+                                   "n_neighbors": 8})
+    out = scrt.infer(level="clone")[0]
+    assert scrt.clone_col == "cluster_id"
+    for col in EXPECTED_COLS:
+        assert col in out.columns
+    # S cells were assigned to the discovered clusters; the two
+    # simulated clones must be separated into >= 2 of them
+    assert out["cluster_id"].nunique() >= 2
+
+
+def test_invalid_clustering_method_raises(sim_data):
+    sim_s, sim_g = sim_data
+    with pytest.raises(ValueError, match="clustering_method"):
+        scRT(sim_s, sim_g, clustering_method="umap")
+
+
 def test_pseudobulk_and_twidth_downstream(sim_data):
     """Downstream RT analysis runs off a deterministic level's output
     (reference: infer_scRT.py:279-290)."""

@@ -11,7 +11,9 @@
 #   2. 10k-cell step-2 bench — the bandwidth-bound regime
 #   3. full pipeline w/ mirror rescue on TPU — perf + accuracy headline
 #   4. 5k-cell full pipeline — scale evidence beyond the 1k artifact
-#   5. 10k-cell full pipeline (cell_chunk for HBM) — best effort,
+#   5. 20kb-bin long-genome (154,770 loci) full pipeline — the regime
+#      the reference's README warns about, on-chip
+#   6. 10k-cell full pipeline (cell_chunk for HBM) — best effort,
 #      capped at MAX_10K_TRIES so it cannot pin the runner forever
 set -u
 cd "$(dirname "$0")/.."
@@ -84,6 +86,10 @@ battery() {  # returns 0 only if every step it attempted succeeded
         python tools/full_pipeline_bench.py --cells 5000 --g1-cells 500 \
             --run-step3 --mirror-rescue \
             --out artifacts/FULL_PIPELINE_r05_5k_tpu.json || return 1
+    run_one FULL_PIPELINE_r05_20kb_tpu platform 2400 \
+        python tools/full_pipeline_bench.py --cells 250 --g1-cells 60 \
+            --bin-size 20000 --run-step3 --mirror-rescue \
+            --out artifacts/FULL_PIPELINE_r05_20kb_tpu.json || return 1
     if [ ! -s artifacts/FULL_PIPELINE_r05_10k_tpu.json ] \
             && [ "$tries_10k" -lt "$MAX_10K_TRIES" ]; then
         tries_10k=$((tries_10k + 1))
@@ -99,7 +105,8 @@ core_done() {
     [ -s artifacts/BENCH_r05_tpu_300iter.json ] \
         && [ -s artifacts/BENCH_r05_tpu_10k.json ] \
         && [ -s artifacts/FULL_PIPELINE_r05_rescue_tpu.json ] \
-        && [ -s artifacts/FULL_PIPELINE_r05_5k_tpu.json ]
+        && [ -s artifacts/FULL_PIPELINE_r05_5k_tpu.json ] \
+        && [ -s artifacts/FULL_PIPELINE_r05_20kb_tpu.json ]
 }
 
 for attempt in $(seq 1 200); do
