@@ -97,6 +97,33 @@ def main(argv=None):
     print(f"simulated {sim_s.cell_id.nunique()} S + "
           f"{sim_g.cell_id.nunique()} G1/2 cells x {args.loci} bins")
 
+    # ---- 1b. clone discovery (cncluster's two paths) --------------------
+    # the simulated frames carry clone_id, so inference below uses the
+    # known clones; this step shows both discovery methods recovering
+    # them from the G1 CN profiles alone (kmeans+BIC is what the
+    # reference hardwires; umap_hdbscan is its optional path,
+    # cncluster.py:10-46)
+    from scdna_replication_tools_tpu.pipeline.clustering import (
+        discover_clones,
+    )
+
+    n_g1 = sim_g.cell_id.nunique()
+    for method, kw in [("kmeans", {"max_k": 4}),
+                       ("umap_hdbscan",
+                        # scaled to the simulated cell count so small
+                        # --cells-per-clone runs don't label everything
+                        # noise (cluster_g1_cells raises on all-noise)
+                        {"min_cluster_size": max(3, n_g1 // 5),
+                         "min_samples": max(2, n_g1 // 10),
+                         "n_neighbors": max(3, min(8, n_g1 - 1))})]:
+        g1_disc, _ = discover_clones(sim_g, "copy", method=method, **kw)
+        ct = pd.crosstab(
+            g1_disc.drop_duplicates("cell_id").set_index("cell_id")
+            .cluster_id,
+            sim_g.drop_duplicates("cell_id").set_index("cell_id").clone_id)
+        print(f"clone discovery ({method}): clusters x true clones\n"
+              f"{ct.to_string()}")
+
     # ---- 2. PERT inference (inference_tutorial.ipynb cell 9) ------------
     from scdna_replication_tools_tpu.api import scRT
 

@@ -22,10 +22,7 @@ from scdna_replication_tools_tpu.infer.runner import (
 )
 from scdna_replication_tools_tpu.models.pert import constrained
 from scdna_replication_tools_tpu.pipeline.assign import assign_s_to_clones
-from scdna_replication_tools_tpu.pipeline.clustering import (
-    discover_clones,
-    kmeans_cluster,
-)
+from scdna_replication_tools_tpu.pipeline.clustering import discover_clones
 from scdna_replication_tools_tpu.pipeline.consensus import (
     compute_consensus_clone_profiles,
 )
@@ -271,12 +268,10 @@ class SPF:
 
     def infer(self):
         if self.clone_col is None:
-            g1_mat = self.cn_g1.pivot_table(
-                columns='cell_id', index=['chr', 'start'],
-                values=self.input_col, observed=True)
-            clusters = kmeans_cluster(g1_mat)
-            self.cn_g1 = pd.merge(self.cn_g1, clusters, on='cell_id')
-            self.clone_col = 'cluster_id'
+            # max_k=100 keeps kmeans_cluster's default search range, as
+            # the reference's SPF does (infer_SPF.py:62-66)
+            self.cn_g1, self.clone_col = discover_clones(
+                self.cn_g1, self.input_col, max_k=100)
 
         self.clone_profiles = compute_consensus_clone_profiles(
             self.cn_g1, self.input_col, clone_col=self.clone_col)
