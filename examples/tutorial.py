@@ -100,9 +100,11 @@ def main(argv=None):
     # ---- 1b. clone discovery (cncluster's two paths) --------------------
     # the simulated frames carry clone_id, so inference below uses the
     # known clones; this step shows both discovery methods recovering
-    # them from the G1 CN profiles alone (kmeans+BIC is what the
-    # reference hardwires; umap_hdbscan is its optional path,
-    # cncluster.py:10-46)
+    # them from the NOISY simulated G1 read counts alone (kmeans+BIC is
+    # what the reference hardwires; umap_hdbscan is its optional path,
+    # cncluster.py:10-46).  Clustering the reads rather than the
+    # noiseless true CN makes the demo honest (and avoids the
+    # zero-variance BIC degeneracies exact duplicates cause).
     from scdna_replication_tools_tpu.pipeline.clustering import (
         discover_clones,
     )
@@ -111,12 +113,13 @@ def main(argv=None):
     for method, kw in [("kmeans", {"max_k": 4}),
                        ("umap_hdbscan",
                         # scaled to the simulated cell count so small
-                        # --cells-per-clone runs don't label everything
-                        # noise (cluster_g1_cells raises on all-noise)
-                        {"min_cluster_size": max(3, n_g1 // 5),
-                         "min_samples": max(2, n_g1 // 10),
+                        # --cells-per-clone runs (>= 2 per clone) don't
+                        # label everything noise (cluster_g1_cells
+                        # raises on all-noise)
+                        {"min_cluster_size": max(2, n_g1 // 5),
+                         "min_samples": max(1, n_g1 // 10),
                          "n_neighbors": max(3, min(8, n_g1 - 1))})]:
-        g1_disc, _ = discover_clones(sim_g, "copy", method=method, **kw)
+        g1_disc, _ = discover_clones(sim_g, "reads", method=method, **kw)
         ct = pd.crosstab(
             g1_disc.drop_duplicates("cell_id").set_index("cell_id")
             .cluster_id,

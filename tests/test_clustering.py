@@ -87,6 +87,40 @@ def test_spectral_embed_sparse_branch_matches_blob_structure():
             assert np.linalg.norm(cents[a] - cents[b]) > 2.0 * spread
 
 
+def test_cluster_g1_cells_error_paths():
+    from scdna_replication_tools_tpu.pipeline.clustering import (
+        cluster_g1_cells,
+    )
+    frame, _ = _blob_frame(n_per_blob=6, n_loci=20)
+    with pytest.raises(ValueError, match="kmeans"):
+        cluster_g1_cells(frame, method="umap")
+    # all-noise (min_cluster_size far above the cell count) raises with
+    # guidance instead of returning an empty clone table
+    with pytest.raises(ValueError, match="noise"):
+        cluster_g1_cells(frame, method="umap_hdbscan", n_neighbors=5)
+
+
+def test_discover_clones_custom_cell_col():
+    """The long-form preamble honors a non-default cell column."""
+    from scdna_replication_tools_tpu.pipeline.clustering import (
+        discover_clones,
+    )
+    frame, truth = _blob_frame()
+    long = (frame.reset_index(names="start")
+            .melt(id_vars="start", var_name="barcode", value_name="copy"))
+    long["chr"] = "1"
+    out, clone_col = discover_clones(long, "copy", cell_col="barcode",
+                                     method="kmeans", min_k=2, max_k=4)
+    assert clone_col == "cluster_id"
+    assert "cluster_id" in out.columns and "barcode" in out.columns
+    per_cell = out.drop_duplicates("barcode").set_index("barcode")
+    tr = pd.Series(truth, index=frame.columns)
+    purity = (per_cell.join(tr.rename("truth")).groupby("truth")
+              ["cluster_id"].agg(lambda s: s.value_counts(normalize=True)
+                                 .iloc[0]))
+    assert (purity > 0.9).all()
+
+
 def test_kmeans_cluster_still_recovers_blobs():
     frame, truth = _blob_frame()
     out = kmeans_cluster(frame, min_k=2, max_k=5)
