@@ -1,0 +1,95 @@
+"""Console entry points, end to end over TSV files.
+
+The reference declares ``infer_scRT``/``infer_SPF`` console scripts whose
+argument parsing is broken (infer_scRT.py:16-22, :303 — get_args never
+returns, main unpacks 2 of 4 values); these tests pin that OUR CLIs
+actually run the simulate -> infer -> analyse loop from files on disk,
+the way a shell user would drive them.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from scdna_replication_tools_tpu.cli import (
+    infer_scrt_main,
+    infer_spf_main,
+    simulator_main,
+)
+
+
+@pytest.fixture(scope="module")
+def cli_dir(tmp_path_factory, synthetic_frames):
+    """Input TSVs + simulator-CLI outputs shared across the CLI tests."""
+    d = tmp_path_factory.mktemp("cli")
+    df_s, df_g = synthetic_frames
+    df_s.to_csv(d / "in_s.tsv", sep="\t", index=False)
+    df_g.to_csv(d / "in_g.tsv", sep="\t", index=False)
+
+    simulator_main(["-si", str(d / "in_s.tsv"), "-gi", str(d / "in_g.tsv"),
+                    "-n", "50000", "-l", "0.75", "-a", "10",
+                    "-b", "0.5", "0.0", "-rt", "rt_A", "rt_B",
+                    "-c", "A", "B",
+                    "-so", str(d / "sim_s.tsv"), "-go", str(d / "sim_g.tsv")])
+
+    for name in ("sim_s", "sim_g"):
+        df = pd.read_csv(d / f"{name}.tsv", sep="\t", dtype={"chr": str})
+        df["reads"] = df["true_reads_norm"]
+        df["state"] = df["true_somatic_cn"].astype(int)
+        df["copy"] = df["true_somatic_cn"].astype(float)
+        df.to_csv(d / f"pert_{name}.tsv", sep="\t", index=False)
+    return d
+
+
+def test_simulator_cli_outputs(cli_dir):
+    sim_s = pd.read_csv(cli_dir / "sim_s.tsv", sep="\t")
+    sim_g = pd.read_csv(cli_dir / "sim_g.tsv", sep="\t")
+    for col in ("true_reads_norm", "true_rep", "true_t", "true_somatic_cn"):
+        assert col in sim_s.columns
+    assert (sim_g["true_rep"] == 0).all()      # G1 cells are unreplicated
+    assert sim_s["true_rep"].mean() > 0.05     # S cells replicate
+
+
+def test_infer_scrt_cli_pert_level(cli_dir):
+    out, supp = cli_dir / "out.tsv", cli_dir / "supp.tsv"
+    infer_scrt_main([str(cli_dir / "pert_sim_s.tsv"),
+                     str(cli_dir / "pert_sim_g.tsv"),
+                     str(out), str(supp),
+                     "--max-iter", "150", "--cn-prior-method", "g1_clones"])
+    res = pd.read_csv(out, sep="\t")
+    for col in ("model_cn_state", "model_rep_state", "model_tau",
+                "model_u", "model_rho"):
+        assert col in res.columns
+    acc = (res["model_rep_state"] == res["true_rep"]).mean()
+    assert acc > 0.9, f"CLI pert rep accuracy {acc:.3f}"
+    losses = pd.read_csv(supp, sep="\t").query("param == 'loss_s'")["value"]
+    assert len(losses) and losses.iloc[-1] < losses.iloc[0]
+
+
+def test_infer_scrt_cli_deterministic_level(cli_dir):
+    out, supp = cli_dir / "out_clone.tsv", cli_dir / "supp_clone.tsv"
+    infer_scrt_main([str(cli_dir / "pert_sim_s.tsv"),
+                     str(cli_dir / "pert_sim_g.tsv"),
+                     str(out), str(supp), "--level", "clone"])
+    res = pd.read_csv(out, sep="\t")
+    for col in ("rt_value", "rt_state", "frac_rt", "binary_thresh"):
+        assert col in res.columns
+    assert set(np.unique(res["rt_state"])) <= {0.0, 1.0}
+
+
+def test_infer_spf_cli(cli_dir):
+    out_s, out_spf = cli_dir / "spf_s.tsv", cli_dir / "spf.tsv"
+    infer_spf_main([str(cli_dir / "pert_sim_s.tsv"),
+                    str(cli_dir / "pert_sim_g.tsv"),
+                    str(out_s), str(out_spf)])
+    spf = pd.read_csv(out_spf, sep="\t")
+    for col in ("clone_id", "SPF", "SPF_std", "num_s", "num_g"):
+        assert col in spf.columns
+    # S cells are reassigned to clones by read-profile correlation
+    # (reference semantics), so per-clone S counts can shift; the pool
+    # totals and the SPF identity are the invariants
+    assert np.isfinite(spf["SPF"]).all()
+    assert spf["num_s"].sum() == 24 and spf["num_g"].sum() == 24
+    np.testing.assert_allclose(
+        spf["SPF"], spf["num_s"] / (spf["num_s"] + spf["num_g"]))
+    assert (spf["SPF_std"] > 0).all()
