@@ -191,6 +191,39 @@ def _per_locus_profile(
     return aligned.to_numpy(dtype=np.float32)
 
 
+def validate_input_frames(
+    cn_s: pd.DataFrame, cn_g1: pd.DataFrame, cols: ColumnConfig
+) -> None:
+    """Fail fast, with named columns, on malformed input frames.
+
+    The reference surfaces these as pandas ``KeyError``s deep inside
+    ``process_input_data`` (pert_model.py:133-191); here the user gets
+    one message naming every missing column per frame up front.
+    """
+    required = {
+        "cn_s": (cn_s, [cols.cell_col, cols.chr_col, cols.start_col,
+                        cols.input_col, cols.library_col, cols.gc_col]),
+        "cn_g1": (cn_g1, [cols.cell_col, cols.chr_col, cols.start_col,
+                          cols.input_col, cols.library_col,
+                          cols.cn_state_col]),
+    }
+    problems = []
+    for name, (frame, needed) in required.items():
+        if frame is None or len(frame) == 0:
+            problems.append(f"{name} is empty")
+            continue
+        missing = [c for c in needed if c not in frame.columns]
+        if missing:
+            problems.append(f"{name} is missing column(s) {missing}")
+    if problems:
+        contract = ", ".join([cols.chr_col, cols.start_col, cols.gc_col,
+                              cols.library_col, cols.cell_col,
+                              cols.input_col, cols.cn_state_col])
+        raise ValueError(
+            "invalid PERT input: " + "; ".join(problems)
+            + f" (long-form contract: {contract} — see README)")
+
+
 def build_pert_inputs(
     cn_s: pd.DataFrame,
     cn_g1: pd.DataFrame,
@@ -202,6 +235,7 @@ def build_pert_inputs(
     genome-ordered sort, NaN-row drop, pivot to dense matrices, shared
     library index, per-locus GC and optional RT-prior profiles.
     """
+    validate_input_frames(cn_s, cn_g1, cols)
     s_reads = pivot_matrix(cn_s, cols.input_col, cols)
     g1_reads = pivot_matrix(cn_g1, cols.input_col, cols)
     g1_states = pivot_matrix(cn_g1, cols.cn_state_col, cols)
@@ -217,6 +251,12 @@ def build_pert_inputs(
     if s_states is not None:
         loci = loci.intersection(s_states.dropna(axis=1).columns)
     loci = loci.sortlevel([0, 1])[0]
+    if len(loci) == 0:
+        raise ValueError(
+            "no locus is fully observed in every pivot (S reads, G1 reads, "
+            "G1 states" + (", S states" if s_states is not None else "")
+            + ") — check that both frames cover the same (chr, start) bins "
+            "and that chromosome labels use the canonical 1..22,X,Y naming")
 
     s_reads = s_reads[loci]
     g1_reads = g1_reads[loci]
@@ -226,9 +266,10 @@ def build_pert_inputs(
 
     libs_s, libs_g1, library_ids = _library_index(cn_s, cn_g1, cols)
 
+    # gc_col presence is guaranteed by validate_input_frames above, so
+    # this cannot return None (it still raises if values are missing for
+    # shared loci)
     gammas = _per_locus_profile(cn_s, cols.gc_col, loci, cols)
-    if gammas is None:
-        raise ValueError(f"GC column {cols.gc_col!r} is required in cn_s")
 
     rt_prior = _per_locus_profile(cn_s, cols.rt_prior_col, loci, cols)
     if rt_prior is not None:

@@ -73,3 +73,26 @@ def test_example_bins_schema():
     # genome-wide at 500kb lands near the reference's 5451 rows
     full = make_example_bins()
     assert 5000 < len(full) < 6500
+
+
+def test_validation_names_missing_columns(synthetic_frames):
+    import pytest
+
+    df_s, df_g = synthetic_frames
+    df_s, df_g = _with_reads(df_s), _with_reads(df_g, 1)
+    bad_s = df_s.drop(columns=["reads", "gc"])
+    with pytest.raises(ValueError, match=r"cn_s is missing column\(s\).*reads.*gc"):
+        build_pert_inputs(bad_s, df_g)
+    with pytest.raises(ValueError, match="cn_g1 is empty"):
+        build_pert_inputs(df_s, df_g.iloc[0:0])
+
+
+def test_validation_disjoint_loci(synthetic_frames):
+    import pytest
+
+    df_s, df_g = synthetic_frames
+    df_s, df_g = _with_reads(df_s), _with_reads(df_g, 1)
+    # shift every G1 bin start so no (chr, start) key is shared
+    df_g = df_g.assign(start=df_g["start"] + 1)
+    with pytest.raises(ValueError, match="no locus is fully observed"):
+        build_pert_inputs(df_s, df_g)
