@@ -9,8 +9,6 @@ accepted for forward compatibility ('jax' is the only backend).
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
-
 import numpy as np
 import pandas as pd
 

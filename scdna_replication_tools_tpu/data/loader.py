@@ -212,16 +212,22 @@ def validate_input_frames(
         if frame is None or len(frame) == 0:
             problems.append(f"{name} is empty")
             continue
-        missing = [c for c in needed if c not in frame.columns]
+        missing = [c for c in needed if c is not None
+                   and c not in frame.columns]
         if missing:
             problems.append(f"{name} is missing column(s) {missing}")
     if problems:
-        contract = ", ".join([cols.chr_col, cols.start_col, cols.gc_col,
-                              cols.library_col, cols.cell_col,
-                              cols.input_col, cols.cn_state_col])
+        # the contract hint is the union of the required lists above, in
+        # first-seen order (a None column name means its feature is off)
+        contract, seen = [], set()
+        for _, needed in required.values():
+            for c in needed:
+                if c is not None and c not in seen:
+                    seen.add(c)
+                    contract.append(c)
         raise ValueError(
             "invalid PERT input: " + "; ".join(problems)
-            + f" (long-form contract: {contract} — see README)")
+            + f" (long-form contract: {', '.join(contract)} — see README)")
 
 
 def build_pert_inputs(
@@ -266,10 +272,14 @@ def build_pert_inputs(
 
     libs_s, libs_g1, library_ids = _library_index(cn_s, cn_g1, cols)
 
-    # gc_col presence is guaranteed by validate_input_frames above, so
-    # this cannot return None (it still raises if values are missing for
-    # shared loci)
+    # column presence is checked by validate_input_frames above, so None
+    # here can only mean gc_col itself was None (validation skips
+    # disabled columns); the model cannot run without GC features
     gammas = _per_locus_profile(cn_s, cols.gc_col, loci, cols)
+    if gammas is None:
+        raise ValueError("gc_col must name a GC-content column; the PERT "
+                         f"model requires GC features (got gc_col="
+                         f"{cols.gc_col!r})")
 
     rt_prior = _per_locus_profile(cn_s, cols.rt_prior_col, loci, cols)
     if rt_prior is not None:

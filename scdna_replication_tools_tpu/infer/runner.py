@@ -32,7 +32,6 @@ import pandas as pd
 from scdna_replication_tools_tpu.config import ColumnConfig, PertConfig
 from scdna_replication_tools_tpu.data.loader import (
     PertData,
-    build_pert_inputs,
     pad_cells,
     pad_loci,
 )

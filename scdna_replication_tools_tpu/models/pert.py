@@ -66,7 +66,7 @@ from scdna_replication_tools_tpu.ops.dists import (
     nb_log_prob,
     normal_log_prob,
 )
-from scdna_replication_tools_tpu.ops.gc import gc_features, gc_rate
+from scdna_replication_tools_tpu.ops.gc import gc_rate
 from scdna_replication_tools_tpu.ops.transforms import (
     from_interval,
     from_positive,

@@ -9,7 +9,7 @@ Manhattan binarisation.  These double as baselines for the PERT model.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Optional
 
 
 from scdna_replication_tools_tpu.config import ColumnConfig

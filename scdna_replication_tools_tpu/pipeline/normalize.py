@@ -28,7 +28,7 @@ Covers the reference modules ``normalize_by_cell.py`` and
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Optional
 
 import numpy as np
 import pandas as pd

@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import matplotlib.colors as mcolors
 import matplotlib.pyplot as plt
-import pandas as pd
 
 from scdna_replication_tools_tpu.plotting.utils import (
     get_clone_cmap,

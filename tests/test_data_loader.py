@@ -1,6 +1,7 @@
 """Data-core tests: pivots, genome ordering, padding/masking."""
 
 import numpy as np
+import pytest
 import pandas as pd
 
 from scdna_replication_tools_tpu.config import ColumnConfig
@@ -76,8 +77,6 @@ def test_example_bins_schema():
 
 
 def test_validation_names_missing_columns(synthetic_frames):
-    import pytest
-
     df_s, df_g = synthetic_frames
     df_s, df_g = _with_reads(df_s), _with_reads(df_g, 1)
     bad_s = df_s.drop(columns=["reads", "gc"])
@@ -88,8 +87,6 @@ def test_validation_names_missing_columns(synthetic_frames):
 
 
 def test_validation_disjoint_loci(synthetic_frames):
-    import pytest
-
     df_s, df_g = synthetic_frames
     df_s, df_g = _with_reads(df_s), _with_reads(df_g, 1)
     # shift every G1 bin start so no (chr, start) key is shared
