@@ -13,7 +13,10 @@ import numpy as np
 import pandas as pd
 
 from scdna_replication_tools_tpu.config import ColumnConfig, PertConfig
-from scdna_replication_tools_tpu.data.loader import build_pert_inputs
+from scdna_replication_tools_tpu.data.loader import (
+    build_pert_inputs,
+    check_frame_columns,
+)
 from scdna_replication_tools_tpu.infer.runner import (
     PertInference,
     package_step_output,
@@ -265,6 +268,17 @@ class SPF:
         self.rng = np.random.default_rng(seed)
 
     def infer(self):
+        # fail fast with named columns, like the PERT loader does.  Only
+        # cn_g1 needs clone_col: assigning clones to the S cells is this
+        # method's own job (assign_s_to_clones below)
+        base = ['cell_id', 'chr', 'start', self.input_col]
+        problems = check_frame_columns({
+            'cn_s': (self.cn_s, base),
+            'cn_g1': (self.cn_g1, base + [self.clone_col]),
+        })
+        if problems:
+            raise ValueError("invalid SPF input: " + "; ".join(problems))
+
         if self.clone_col is None:
             # max_k=100 keeps kmeans_cluster's default search range, as
             # the reference's SPF does (infer_SPF.py:62-66)

@@ -25,7 +25,13 @@ def infer_scrt_main(argv=None):
                    choices=["pert", "pyro", "jax", "cell", "clone", "bulk"])
     p.add_argument("--max-iter", type=int, default=2000)
     p.add_argument("--cn-prior-method", default="g1_composite")
-    p.add_argument("--clone-col", default="clone_id")
+    p.add_argument("--clone-col", default="clone_id",
+                   help="clone column; pass 'none' to discover clones by "
+                        "clustering the G1 cells instead")
+    p.add_argument("--clustering-method", default="kmeans",
+                   choices=["kmeans", "umap_hdbscan"],
+                   help="clone-discovery algorithm used when "
+                        "--clone-col none")
     p.add_argument("--num-shards", type=int, default=1)
     p.add_argument("--mirror-rescue", action="store_true",
                    help="post-step-2 mirror-basin rescue for boundary-tau "
@@ -37,9 +43,12 @@ def infer_scrt_main(argv=None):
     cn_s = pd.read_csv(args.s_phase_cells, sep="\t", dtype={"chr": str})
     cn_g1 = pd.read_csv(args.g1_phase_cells, sep="\t", dtype={"chr": str})
 
-    scrt = scRT(cn_s, cn_g1, clone_col=args.clone_col,
+    clone_col = (None if args.clone_col.lower() == "none"
+                 else args.clone_col)
+    scrt = scRT(cn_s, cn_g1, clone_col=clone_col,
                 cn_prior_method=args.cn_prior_method,
                 max_iter=args.max_iter, num_shards=args.num_shards,
+                clustering_method=args.clustering_method,
                 mirror_rescue=args.mirror_rescue)
     out_df, supp_df, _, _ = scrt.infer(level=args.level)
 
@@ -54,7 +63,9 @@ def infer_spf_main(argv=None):
     p.add_argument("output_s", help="S cells with clone assignments")
     p.add_argument("output_spf", help="per-clone SPF table")
     p.add_argument("--input-col", default="reads")
-    p.add_argument("--clone-col", default="clone_id")
+    p.add_argument("--clone-col", default="clone_id",
+                   help="clone column; pass 'none' to discover clones by "
+                        "clustering the G1 cells instead")
     args = p.parse_args(argv)
 
     from scdna_replication_tools_tpu.api import SPF
@@ -63,7 +74,8 @@ def infer_spf_main(argv=None):
     cn_g1 = pd.read_csv(args.g1_phase_cells, sep="\t", dtype={"chr": str})
 
     spf = SPF(cn_s, cn_g1, input_col=args.input_col,
-              clone_col=args.clone_col)
+              clone_col=(None if args.clone_col.lower() == "none"
+                         else args.clone_col))
     cn_s, out_df = spf.infer()
     cn_s.to_csv(args.output_s, sep="\t", index=False)
     out_df.to_csv(args.output_spf, sep="\t", index=False)

@@ -191,6 +191,26 @@ def _per_locus_profile(
     return aligned.to_numpy(dtype=np.float32)
 
 
+def check_frame_columns(frames) -> List[str]:
+    """Problem strings for ``{name: (frame, needed_columns)}``.
+
+    Reports empty frames and missing columns per frame; ``None`` column
+    names (disabled features) are skipped.  Shared by the PERT loader
+    (:func:`validate_input_frames`) and the SPF facade so the two
+    validations cannot drift.
+    """
+    problems = []
+    for name, (frame, needed) in frames.items():
+        if frame is None or len(frame) == 0:
+            problems.append(f"{name} is empty")
+            continue
+        missing = [c for c in needed if c is not None
+                   and c not in frame.columns]
+        if missing:
+            problems.append(f"{name} is missing column(s) {missing}")
+    return problems
+
+
 def validate_input_frames(
     cn_s: pd.DataFrame, cn_g1: pd.DataFrame, cols: ColumnConfig
 ) -> None:
@@ -207,15 +227,7 @@ def validate_input_frames(
                           cols.input_col, cols.library_col,
                           cols.cn_state_col]),
     }
-    problems = []
-    for name, (frame, needed) in required.items():
-        if frame is None or len(frame) == 0:
-            problems.append(f"{name} is empty")
-            continue
-        missing = [c for c in needed if c is not None
-                   and c not in frame.columns]
-        if missing:
-            problems.append(f"{name} is missing column(s) {missing}")
+    problems = check_frame_columns(required)
     if problems:
         # the contract hint is the union of the required lists above, in
         # first-seen order (a None column name means its feature is off)

@@ -93,3 +93,41 @@ def test_infer_spf_cli(cli_dir):
     np.testing.assert_allclose(
         spf["SPF"], spf["num_s"] / (spf["num_s"] + spf["num_g"]))
     assert (spf["SPF_std"] > 0).all()
+
+
+def test_infer_scrt_cli_clone_discovery(cli_dir):
+    """--clone-col none triggers G1 clustering before the clone level."""
+    out, supp = cli_dir / "out_disc.tsv", cli_dir / "supp_disc.tsv"
+    infer_scrt_main([str(cli_dir / "pert_sim_s.tsv"),
+                     str(cli_dir / "pert_sim_g.tsv"),
+                     str(out), str(supp), "--level", "clone",
+                     "--clone-col", "none",
+                     "--clustering-method", "kmeans"])
+    res = pd.read_csv(out, sep="\t")
+    assert "cluster_id" in res.columns
+    assert res["cluster_id"].nunique() >= 2
+
+
+def test_infer_spf_cli_without_s_clone_column(cli_dir, tmp_path):
+    """SPF's own job is assigning S cells to clones: cn_s without a
+    clone column is canonical input and must run (cn_g1 carries it)."""
+    s = pd.read_csv(cli_dir / "pert_sim_s.tsv", sep="\t") \
+        .drop(columns=["clone_id"])
+    s_path = tmp_path / "s_noclone.tsv"
+    s.to_csv(s_path, sep="\t", index=False)
+    out_s, out_spf = tmp_path / "s_out.tsv", tmp_path / "spf_out.tsv"
+    infer_spf_main([str(s_path), str(cli_dir / "pert_sim_g.tsv"),
+                    str(out_s), str(out_spf)])
+    spf = pd.read_csv(out_spf, sep="\t")
+    assert spf["num_s"].sum() == 24
+
+
+def test_infer_spf_cli_validation_error(cli_dir, tmp_path):
+    """A frame missing the input column fails fast with a named message."""
+    bad = pd.read_csv(cli_dir / "pert_sim_s.tsv", sep="\t") \
+        .drop(columns=["reads"])
+    bad_path = tmp_path / "bad_s.tsv"
+    bad.to_csv(bad_path, sep="\t", index=False)
+    with pytest.raises(ValueError, match=r"cn_s is missing column\(s\).*reads"):
+        infer_spf_main([str(bad_path), str(cli_dir / "pert_sim_g.tsv"),
+                        str(tmp_path / "o1.tsv"), str(tmp_path / "o2.tsv")])
