@@ -22,6 +22,8 @@ COPY bench.py ./
 
 RUN pip install --no-cache-dir "jax[cpu]>=0.7,<0.10" optax pytest scipy \
         scikit-learn pandas matplotlib seaborn \
+    && pip install --no-cache-dir "torch>=2,<3" \
+        --index-url https://download.pytorch.org/whl/cpu \
     && pip install --no-cache-dir -e .
 
 # gate the image on a green suite, like the reference's Docker build

@@ -14,6 +14,14 @@ from argparse import ArgumentParser
 
 import pandas as pd
 
+_CLONE_COL_HELP = ("clone column; pass 'none' to discover clones by "
+                   "clustering the G1 cells instead")
+
+
+def _parse_clone_col(value):
+    """CLI sentinel: the string 'none' (any case) means clone discovery."""
+    return None if value.lower() == "none" else value
+
 
 def infer_scrt_main(argv=None):
     p = ArgumentParser(description="Infer scRT profiles with TPU-native PERT")
@@ -26,8 +34,7 @@ def infer_scrt_main(argv=None):
     p.add_argument("--max-iter", type=int, default=2000)
     p.add_argument("--cn-prior-method", default="g1_composite")
     p.add_argument("--clone-col", default="clone_id",
-                   help="clone column; pass 'none' to discover clones by "
-                        "clustering the G1 cells instead")
+                   help=_CLONE_COL_HELP)
     p.add_argument("--clustering-method", default="kmeans",
                    choices=["kmeans", "umap_hdbscan"],
                    help="clone-discovery algorithm used when "
@@ -43,9 +50,7 @@ def infer_scrt_main(argv=None):
     cn_s = pd.read_csv(args.s_phase_cells, sep="\t", dtype={"chr": str})
     cn_g1 = pd.read_csv(args.g1_phase_cells, sep="\t", dtype={"chr": str})
 
-    clone_col = (None if args.clone_col.lower() == "none"
-                 else args.clone_col)
-    scrt = scRT(cn_s, cn_g1, clone_col=clone_col,
+    scrt = scRT(cn_s, cn_g1, clone_col=_parse_clone_col(args.clone_col),
                 cn_prior_method=args.cn_prior_method,
                 max_iter=args.max_iter, num_shards=args.num_shards,
                 clustering_method=args.clustering_method,
@@ -64,8 +69,7 @@ def infer_spf_main(argv=None):
     p.add_argument("output_spf", help="per-clone SPF table")
     p.add_argument("--input-col", default="reads")
     p.add_argument("--clone-col", default="clone_id",
-                   help="clone column; pass 'none' to discover clones by "
-                        "clustering the G1 cells instead")
+                   help=_CLONE_COL_HELP)
     args = p.parse_args(argv)
 
     from scdna_replication_tools_tpu.api import SPF
@@ -74,8 +78,7 @@ def infer_spf_main(argv=None):
     cn_g1 = pd.read_csv(args.g1_phase_cells, sep="\t", dtype={"chr": str})
 
     spf = SPF(cn_s, cn_g1, input_col=args.input_col,
-              clone_col=(None if args.clone_col.lower() == "none"
-                         else args.clone_col))
+              clone_col=_parse_clone_col(args.clone_col))
     cn_s, out_df = spf.infer()
     cn_s.to_csv(args.output_s, sep="\t", index=False)
     out_df.to_csv(args.output_spf, sep="\t", index=False)
