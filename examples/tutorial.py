@@ -71,6 +71,28 @@ def make_input_frames(num_loci=150, cells_per_clone=20, seed=7):
     return df_s, df_g
 
 
+def simulate_pert_frames(df_s, df_g, num_reads=50_000, lamb=0.75, a=10.0,
+                         seed=3):
+    """Simulate reads and alias them into the PERT input convention.
+
+    The tutorial (and tools/accuracy_sweep.py, which imports this) feeds
+    the simulator's normalised read counts as ``reads`` and the true
+    somatic CN as both ``state`` and ``copy`` — one place so the
+    convention cannot drift between the walkthrough and the sweep.
+    """
+    from scdna_replication_tools_tpu.models.simulator import pert_simulator
+
+    sim_s, sim_g = pert_simulator(
+        df_s, df_g, num_reads=num_reads, rt_cols=["rt_A", "rt_B"],
+        clones=["A", "B"], lamb=lamb, betas=np.array([0.5, 0.0]), a=a,
+        seed=seed)
+    for d in (sim_s, sim_g):
+        d["reads"] = d["true_reads_norm"]
+        d["state"] = d["true_somatic_cn"]
+        d["copy"] = d["true_somatic_cn"]
+    return sim_s, sim_g
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--outdir", default="pert_tutorial_out")
@@ -83,17 +105,8 @@ def main(argv=None):
     os.makedirs(args.outdir, exist_ok=True)
 
     # ---- 1. simulate (simulator_tutorial.ipynb) -------------------------
-    from scdna_replication_tools_tpu.models.simulator import pert_simulator
-
     df_s, df_g = make_input_frames(args.loci, args.cells_per_clone)
-    sim_s, sim_g = pert_simulator(
-        df_s, df_g, num_reads=50_000, rt_cols=["rt_A", "rt_B"],
-        clones=["A", "B"], lamb=0.75, betas=np.array([0.5, 0.0]), a=10.0,
-        seed=3)
-    for d in (sim_s, sim_g):
-        d["reads"] = d["true_reads_norm"]
-        d["state"] = d["true_somatic_cn"]
-        d["copy"] = d["true_somatic_cn"]
+    sim_s, sim_g = simulate_pert_frames(df_s, df_g)
     print(f"simulated {sim_s.cell_id.nunique()} S + "
           f"{sim_g.cell_id.nunique()} G1/2 cells x {args.loci} bins")
 
