@@ -28,19 +28,38 @@ import time
 import numpy as np
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+# sibling tools are importable too (force_cpu_backend lives in
+# full_pipeline_bench)
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+
+
+_TUTORIAL_MOD = None
 
 
 def _tutorial():
-    """Import examples/tutorial.py (not a package) for its frame builder."""
-    path = pathlib.Path(__file__).resolve().parents[1] / "examples" / "tutorial.py"
-    spec = importlib.util.spec_from_file_location("pert_tutorial", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+    """Import examples/tutorial.py (not a package) for its frame builder,
+    once — re-executing it per config would stack duplicate sys.path
+    entries from its module body."""
+    global _TUTORIAL_MOD
+    if _TUTORIAL_MOD is None:
+        path = (pathlib.Path(__file__).resolve().parents[1]
+                / "examples" / "tutorial.py")
+        spec = importlib.util.spec_from_file_location("pert_tutorial", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _TUTORIAL_MOD = mod
+    return _TUTORIAL_MOD
+
+
+def _round_or_none(x, nd=4):
+    """NaN-safe metric for the JSON artifact (bare NaN tokens break
+    strict RFC 8259 parsers)."""
+    x = float(x)
+    return None if not np.isfinite(x) else round(x, nd)
 
 
 def run_config(num_reads, lamb, a, cells_per_clone, num_loci, max_iter,
-               seed):
+               seed, mirror_rescue=False):
     import pandas as pd
 
     from scdna_replication_tools_tpu.api import scRT
@@ -53,7 +72,8 @@ def run_config(num_reads, lamb, a, cells_per_clone, num_loci, max_iter,
 
     t0 = time.perf_counter()
     scrt = scRT(sim_s, sim_g, cn_prior_method="g1_clones",
-                max_iter=max_iter, min_iter=100)
+                max_iter=max_iter, min_iter=100,
+                mirror_rescue=mirror_rescue)
     cn_s_out, supp_s, _, _ = scrt.infer(level="pert")
     wall = time.perf_counter() - t0
 
@@ -65,14 +85,15 @@ def run_config(num_reads, lamb, a, cells_per_clone, num_loci, max_iter,
         "num_reads": num_reads, "lamb": lamb, "a": a,
         "cells_per_clone": cells_per_clone, "num_loci": num_loci,
         "max_iter": max_iter, "seed": seed,
-        "rep_accuracy": round(float(
-            (cn_s_out.model_rep_state == cn_s_out.true_rep).mean()), 4),
-        "cn_accuracy": round(float(
-            (cn_s_out.model_cn_state == cn_s_out.true_somatic_cn).mean()), 4),
-        "tau_corr": round(float(np.corrcoef(
-            per_cell.model_tau, per_cell.true_t)[0, 1]), 4),
-        "lambda_abs_err": (None if np.isnan(model_lambda)
-                           else round(abs(model_lambda - lamb), 4)),
+        "mirror_rescue": bool(mirror_rescue),
+        "mirror_rescue_stats": getattr(scrt, "mirror_rescue_stats", None),
+        "rep_accuracy": _round_or_none(
+            (cn_s_out.model_rep_state == cn_s_out.true_rep).mean()),
+        "cn_accuracy": _round_or_none(
+            (cn_s_out.model_cn_state == cn_s_out.true_somatic_cn).mean()),
+        "tau_corr": _round_or_none(np.corrcoef(
+            per_cell.model_tau, per_cell.true_t)[0, 1]),
+        "lambda_abs_err": _round_or_none(abs(model_lambda - lamb)),
         "wall_seconds": round(wall, 1),
     }
 
@@ -86,26 +107,27 @@ def main(argv=None):
                     default=[10_000, 25_000, 50_000],
                     help="coverage sweep: reads per cell")
     ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--mirror-rescue", action="store_true",
+                    help="also run every coverage with the mirror-basin "
+                         "rescue enabled, for a paired comparison")
     ap.add_argument("--out", default=None)
     ap.add_argument("--platform", default="ambient",
                     choices=["ambient", "cpu"])
     args = ap.parse_args(argv)
     if args.platform == "cpu":
-        import os
+        from full_pipeline_bench import force_cpu_backend
 
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+        force_cpu_backend()
 
     results = []
     for num_reads in args.num_reads:
-        r = run_config(num_reads, lamb=0.75, a=10.0,
-                       cells_per_clone=args.cells_per_clone,
-                       num_loci=args.loci, max_iter=args.max_iter,
-                       seed=args.seed)
-        print(json.dumps(r))
-        results.append(r)
+        for rescue in ([False, True] if args.mirror_rescue else [False]):
+            r = run_config(num_reads, lamb=0.75, a=10.0,
+                           cells_per_clone=args.cells_per_clone,
+                           num_loci=args.loci, max_iter=args.max_iter,
+                           seed=args.seed, mirror_rescue=rescue)
+            print(json.dumps(r))
+            results.append(r)
 
     import jax
 

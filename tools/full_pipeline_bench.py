@@ -35,6 +35,22 @@ import pandas as pd
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
 
 
+def force_cpu_backend():
+    """Force the CPU jax backend before first device access.
+
+    The ambient tunneled-TPU backend hangs ~30 min before erroring when
+    the tunnel is down; jax is pre-imported by sitecustomize, so the env
+    var alone cannot do this — the live config must be updated too.
+    Shared by the bench tools (accuracy_sweep imports it from here).
+    """
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
 def make_genome_workload(num_s_cells, num_g1_cells, bin_size=500_000,
                          seed=0):
     """Long-form S/G1 frames over the genome-wide example bin table.
@@ -228,12 +244,7 @@ def main(argv=None):
                          "alone cannot do this)")
     args = ap.parse_args(argv)
     if args.platform == "cpu":
-        import os
-
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+        force_cpu_backend()
     needed = args.num_shards * args.loci_shards
     if needed > 1:
         _ensure_devices(needed)
