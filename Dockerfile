@@ -18,6 +18,7 @@ COPY pyproject.toml README.md ./
 COPY scdna_replication_tools_tpu ./scdna_replication_tools_tpu
 COPY tests ./tests
 COPY examples ./examples
+COPY tools ./tools
 COPY bench.py ./
 
 RUN pip install --no-cache-dir "jax[cpu]>=0.7,<0.10" optax pytest scipy \

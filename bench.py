@@ -243,19 +243,45 @@ def bench_torch_cpu(num_cells, num_loci, P, K, iters):
     return wall / iters, float(loss)
 
 
+# budget presets fill only the size/iteration args the caller did NOT
+# pass explicitly.  'full' is the historical default (hg19 @ 500kb, the
+# production-shaped problem); 'fast' exists because the bare
+# ``python bench.py`` harness invocation must finish well inside its
+# window — BENCH_r05 recorded rc=124 (timeout) with NO parsed output,
+# which is strictly worse than a small-shape number.
+BUDGETS = {
+    "full": {"cells": 1000, "loci": 5451, "iters": 100,
+             "baseline_iters": 20, "probe_timeout": 150},
+    "fast": {"cells": 256, "loci": 1024, "iters": 50,
+             "baseline_iters": 5, "probe_timeout": 60},
+}
+
+
+def apply_budget(args):
+    """Fill None-valued size args from the chosen budget preset."""
+    for name, value in BUDGETS[args.budget].items():
+        if getattr(args, name) is None:
+            setattr(args, name, value)
+    return args
+
+
 def _parse_args(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("--cells", type=int, default=1000)
-    ap.add_argument("--loci", type=int, default=5451)  # hg19 @ 500kb
+    ap.add_argument("--budget", default="fast", choices=sorted(BUDGETS),
+                    help="size preset for args not given explicitly "
+                         "(default fast: finishes in minutes on CPU; "
+                         "full: the production-shaped 1000x5451 problem)")
+    ap.add_argument("--cells", type=int, default=None)
+    ap.add_argument("--loci", type=int, default=None)  # full: hg19 @ 500kb
     ap.add_argument("--P", type=int, default=13)
     ap.add_argument("--K", type=int, default=4)
-    ap.add_argument("--iters", type=int, default=100)
+    ap.add_argument("--iters", type=int, default=None)
     ap.add_argument("--cpu-iters", type=int, default=5,
                     help="iters cap when running on the CPU fallback")
-    # 20 iterations: at 5 the round-2 -> round-3 baseline drifted 37%
-    # between otherwise-identical runs; 20 brings run-to-run spread of the
-    # per-iter mean under a few percent (torch CPU steady state)
-    ap.add_argument("--baseline-iters", type=int, default=20)
+    # 20 iterations (full): at 5 the round-2 -> round-3 baseline drifted
+    # 37% between otherwise-identical runs; 20 brings run-to-run spread of
+    # the per-iter mean under a few percent (torch CPU steady state)
+    ap.add_argument("--baseline-iters", type=int, default=None)
     ap.add_argument("--skip-baseline", action="store_true")
     ap.add_argument("--enum-impl", default="auto",
                     choices=["auto", "xla", "pallas", "pallas_sparse",
@@ -264,10 +290,10 @@ def _parse_args(argv=None):
                     choices=["auto", "tpu", "cpu"],
                     help="'auto' probes the ambient backend in a "
                          "subprocess and falls back to cpu")
-    ap.add_argument("--probe-timeout", type=int, default=150)
+    ap.add_argument("--probe-timeout", type=int, default=None)
     ap.add_argument("--fallback-reason", default=None,
                     help=argparse.SUPPRESS)  # set by the re-exec path only
-    return ap.parse_args(argv)
+    return apply_budget(ap.parse_args(argv))
 
 
 def _run(args, platform, probe_attempts=None):
@@ -348,6 +374,7 @@ def _run(args, platform, probe_attempts=None):
         "unit": f"cells/sec ({args.cells}x{args.loci} bins, P={args.P}, "
                 f"enumerated SVI step)",
         "vs_baseline": None if vs is None else round(vs, 2),
+        "budget": args.budget,
         "platform": platform,
         "device_platform": device_platform,
         # enum_impl round-trips into PertConfig.enum_impl; the sparse
@@ -392,7 +419,21 @@ def main():
         _run(args, platform, probe_attempts)
     except Exception as exc:  # noqa: BLE001 — a number must always land
         if platform.startswith("cpu"):
-            raise  # CPU is the floor; nothing further to fall back to
+            # CPU is the floor; nothing further to fall back to — but a
+            # JSON line must STILL land (a consumer parsing stdout should
+            # see the failure, not an empty artifact like BENCH_r05's)
+            print(json.dumps({
+                "metric": "pert_step2_svi_cells_per_sec",
+                "value": None,
+                "unit": f"cells/sec ({args.cells}x{args.loci} bins, "
+                        f"P={args.P}, enumerated SVI step)",
+                "vs_baseline": None,
+                "budget": args.budget,
+                "platform": platform,
+                "error": repr(exc)[:400],
+                "fallback_reason": args.fallback_reason,
+            }))
+            raise
         # accelerator path died mid-run (compile error, OOM, tunnel drop):
         # re-exec on CPU in a fresh process so stale backend state can't
         # leak, and forward its JSON line (with the cause recorded)
@@ -400,6 +441,7 @@ def main():
               "re-running on cpu fallback", file=sys.stderr)
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         argv = [sys.executable, __file__, "--platform", "cpu",
+                "--budget", args.budget,
                 "--fallback-reason",
                 (f"{platform} run failed: {exc!r}")[:400],
                 "--cells", str(args.cells), "--loci", str(args.loci),

@@ -37,7 +37,8 @@ def transition_log_probs(P: int, self_prob: float) -> jnp.ndarray:
     unused data-derived count matrix (reference: pert_model.py:260-269)."""
     off = (1.0 - self_prob) / (P - 1)
     t = jnp.full((P, P), jnp.log(off), jnp.float32)
-    return t.at[jnp.arange(P), jnp.arange(P)].set(jnp.log(self_prob))
+    diag = jnp.arange(P, dtype=jnp.int32)
+    return t.at[diag, diag].set(jnp.log(self_prob))
 
 
 def _viterbi_single(emissions: jnp.ndarray, restart: jnp.ndarray,

@@ -202,7 +202,8 @@ def init_params(spec: PertModelSpec, batch: PertBatch, fixed: dict,
         jnp.tile(jnp.logspace(0.0, -spec.K, Kp1, dtype=jnp.float32), (spec.L, 1))
     )
     if not spec.cond_rho:
-        params["rho_raw"] = jnp.full((num_loci,), from_unit_interval(0.5))
+        params["rho_raw"] = jnp.full((num_loci,), from_unit_interval(0.5),
+                                     jnp.float32)
 
     if spec.tau_mode == "param":
         t0 = jnp.asarray(t_init, jnp.float32) if t_init is not None \
@@ -212,7 +213,8 @@ def init_params(spec: PertModelSpec, batch: PertBatch, fixed: dict,
         mean = batch.t_alpha / (batch.t_alpha + batch.t_beta)
         params["tau_raw"] = from_unit_interval(jnp.clip(mean, 1e-4, 1.0 - 1e-4))
     else:
-        params["tau_raw"] = jnp.full((num_cells,), from_unit_interval(0.5))
+        params["tau_raw"] = jnp.full((num_cells,), from_unit_interval(0.5),
+                                     jnp.float32)
 
     # u init at the prior median u_guess evaluated at the initial tau
     tau0 = to_unit_interval(params["tau_raw"])

@@ -109,8 +109,9 @@ def gmm2_em(x: jnp.ndarray, num_iters: int = 60, eps: float = 1e-6
     lo = jnp.percentile(x, 25.0, axis=1)
     hi = jnp.percentile(x, 75.0, axis=1)
     mu = jnp.stack([lo, hi], axis=1)                      # (cells, 2)
-    var = jnp.var(x, axis=1, keepdims=True) * jnp.ones((1, 2)) + eps
-    w = jnp.full(mu.shape, 0.5)
+    var = jnp.var(x, axis=1, keepdims=True) * jnp.ones((1, 2), jnp.float32) \
+        + eps
+    w = jnp.full(mu.shape, 0.5, jnp.float32)
 
     def em_step(carry, _):
         mu, var, w = carry
@@ -203,11 +204,11 @@ def manhattan_binarize(
 
     if thresh_from_binaries:
         # per-cell grids linspace(b0, b1, T) (pert_model.py:404)
-        frac = jnp.linspace(0.0, 1.0, num_thresh)
+        frac = jnp.linspace(0.0, 1.0, num_thresh, dtype=jnp.float32)
         threshs = b0[:, None] + (b1 - b0)[:, None] * frac[None, :]
     else:
         threshs = jnp.broadcast_to(
-            jnp.linspace(-3.0, 3.0, num_thresh)[None, :],
+            jnp.linspace(-3.0, 3.0, num_thresh, dtype=jnp.float32)[None, :],
             (x.shape[0], num_thresh))
 
     def scan_step(best, t):
@@ -219,7 +220,8 @@ def manhattan_binarize(
         return (jnp.where(better, dist, best_dist),
                 jnp.where(better, t, best_t)), dist
 
-    init = (jnp.full((x.shape[0],), jnp.inf), jnp.zeros((x.shape[0],)))
+    init = (jnp.full((x.shape[0],), jnp.inf, jnp.float32),
+            jnp.zeros((x.shape[0],), jnp.float32))
     (best_dist, best_t), all_dists = jax.lax.scan(scan_step, init, threshs.T)
 
     rt_state = (x > best_t[:, None]).astype(jnp.int32)

@@ -120,11 +120,20 @@ def discover_clones(cn_g1: pd.DataFrame, value_col: str,
                                values=value_col, observed=True)
     clusters = cluster_g1_cells(g1_mat, method, cell_col=cell_col,
                                 **kwargs)
+    if "cluster_id" in cn_g1.columns:
+        # e.g. re-running inference on a previous run's output: without
+        # the drop, the merge suffixes to cluster_id_x/_y and every
+        # downstream consumer KeyErrors on 'cluster_id'
+        logging.warning("discover_clones: input frame already has a "
+                        "cluster_id column; overwriting it with the fresh "
+                        "clustering")
+        cn_g1 = cn_g1.drop(columns=["cluster_id"])
     return pd.merge(cn_g1, clusters, on=cell_col), "cluster_id"
 
 
 def spectral_embed(X: np.ndarray, n_components: int = 2,
-                   n_neighbors: int = 15) -> np.ndarray:
+                   n_neighbors: int = 15, dense_cutoff: int = 2048
+                   ) -> np.ndarray:
     """Deterministic kNN-graph spectral embedding (Laplacian eigenmaps).
 
     Stands in for UMAP in ``umap_hdbscan_cluster`` (umap-learn is not
@@ -170,13 +179,28 @@ def spectral_embed(X: np.ndarray, n_components: int = 2,
     d_inv_sqrt = 1.0 / np.sqrt(deg)
     dm = scipy.sparse.diags(d_inv_sqrt)
     lap = scipy.sparse.identity(n, format="csr") - dm @ w @ dm
-    if n <= 2048:
+    if n <= dense_cutoff:
         # dense eigh: free of ARPACK convergence concerns at small n
         _, vecs = np.linalg.eigh(lap.toarray())
     else:
-        vals_, vecs = scipy.sparse.linalg.eigsh(
-            lap, k=n_components + 1, sigma=0.0, which="LM")
-        vecs = vecs[:, np.argsort(vals_)]   # ascending, like eigh
+        try:
+            # shift-invert about a small NEGATIVE sigma: the normalized
+            # Laplacian is exactly singular (0 is always an eigenvalue,
+            # with multiplicity >1 for disconnected kNN graphs, which
+            # well-separated clone blobs routinely produce), so
+            # sigma=0.0 hands SuperLU an exactly singular factorization;
+            # L + 1e-6*I is safely positive definite and the bottom
+            # eigenvectors are unchanged
+            vals_, vecs = scipy.sparse.linalg.eigsh(
+                lap, k=n_components + 1, sigma=-1e-6, which="LM")
+            vecs = vecs[:, np.argsort(vals_)]   # ascending, like eigh
+        except Exception:  # noqa: BLE001 — SuperLU raises RuntimeError,
+            # ARPACK ArpackError/ArpackNoConvergence; either way the
+            # dense path is a correct (cubic) fallback
+            logging.warning("spectral_embed: sparse eigsh failed at "
+                            "n=%d; falling back to dense eigh", n,
+                            exc_info=True)
+            _, vecs = np.linalg.eigh(lap.toarray())
     emb = vecs[:, 1:1 + n_components] * d_inv_sqrt[:, None]
     # fix eigenvector sign for determinism across LAPACK builds
     signs = np.sign(emb[np.argmax(np.abs(emb), axis=0),

@@ -1,0 +1,15 @@
+# pertlint test fixture: PL004 dtype-drift.  Parsed, never imported.
+# Lives under an ops/ directory component so the path-scoped rule fires.
+import jax.numpy as jnp
+
+
+def make_arrays(n):
+    a = jnp.zeros((n,))  # expect: PL004
+    b = jnp.full((n,), 1.0)  # expect: PL004
+    c = jnp.ones((n, 2))  # expect: PL004
+    d = jnp.array([1.0, 2.0])  # expect: PL004
+    pos = jnp.zeros((n,), jnp.float32)          # positional dtype: ok
+    kw = jnp.full((n,), 1.0, dtype=jnp.float32)  # keyword dtype: ok
+    conv = jnp.asarray([1.0, 2.0])              # conversion: exempt
+    sup = jnp.ones((n,))  # pertlint: disable=PL004
+    return a, b, c, d, pos, kw, conv, sup

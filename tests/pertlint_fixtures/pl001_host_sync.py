@@ -1,0 +1,34 @@
+# pertlint test fixture: PL001 host-sync-in-jit.  Parsed, never imported.
+# Violation lines end with an expect-marker comment; suppressed lines
+# carry the inline disable comment and must land in the suppressed list.
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def entry(x):
+    bad = float(x)  # expect: PL001
+    shape_ok = float(x.shape[0])        # static metadata: exempt
+    lit_ok = int(1e6)                   # literal: exempt
+    len_ok = int(len(x))                # len(): exempt
+    sup = jnp.sum(x).item()  # pertlint: disable=PL001
+    pulled = jax.device_get(x)  # expect: PL001
+    return helper(bad + sup + shape_ok + lit_ok + len_ok) + pulled
+
+
+def helper(y):
+    # reachable from `entry` (same-module call closure) -> traced
+    return np.asarray(y)  # expect: PL001
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def with_statics(x, n):
+    return x * int(n)                   # static_argnames: exempt
+
+
+def host_side(x):
+    # not reachable from any jit entry: host code may sync freely
+    return float(np.asarray(x).mean())

@@ -1,0 +1,28 @@
+# pertlint test fixture: PL002 tracer-branch.  Parsed, never imported.
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@jax.jit
+def entry(x, flag=None):
+    if jnp.isnan(x).any():  # expect: PL002
+        x = x * 0.0
+    while jax.numpy.sum(x) > 0:  # expect: PL002
+        x = x - 1.0
+    if lax.cumsum(x)[0] > 0:  # expect: PL002
+        x = x + 1.0
+    if flag is None:                    # static/None test: exempt
+        x = x + 2.0
+    if isinstance(flag, str):           # host-level type test: exempt
+        x = x + 3.0
+    if jnp.any(x > 0):  # pertlint: disable=PL002
+        x = x * 2.0
+    return x
+
+
+def host_side(x):
+    # untraced: Python control flow on jnp results is legal host code
+    if jnp.isnan(x).any():
+        return 0.0
+    return 1.0

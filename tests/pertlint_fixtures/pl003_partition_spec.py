@@ -1,0 +1,12 @@
+# pertlint test fixture: PL003 raw-partitionspec.  Parsed, never imported.
+# This file is NOT named layout.py, so every construction is a violation.
+import jax.sharding
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def build_specs(mesh):
+    a = P("cells", None)  # expect: PL003
+    b = jax.sharding.PartitionSpec("cells")  # expect: PL003
+    c = P()  # pertlint: disable=PL003 — fixture's sanctioned escape hatch
+    # consuming a spec someone else built is fine; only construction gates
+    return NamedSharding(mesh, a), b, c

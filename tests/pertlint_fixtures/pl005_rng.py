@@ -1,0 +1,12 @@
+# pertlint test fixture: PL005 unseeded-rng.  Parsed, never imported.
+import numpy as np
+
+
+def sample(n):
+    bad = np.random.rand(n)  # expect: PL005
+    np.random.seed(0)  # expect: PL005
+    shuffled = np.random.permutation(n)  # expect: PL005
+    rng = np.random.default_rng(0)      # explicit generator: exempt
+    good = rng.normal(size=n)
+    sup = np.random.randn(n)  # pertlint: disable=PL005
+    return bad, shuffled, good, sup

@@ -1,0 +1,27 @@
+# pertlint test fixture: PL006 jit-in-loop.  Parsed, never imported.
+import functools
+
+import jax
+
+
+@jax.jit
+def decorated(x):                       # decorator position: exempt
+    return x
+
+
+hoisted = jax.jit(decorated)            # module level, outside loops: ok
+
+
+def compile_per_item(fns, xs):
+    outs = []
+    for f in fns:
+        outs.append(jax.jit(f))  # expect: PL006
+        step = functools.partial(jax.jit, static_argnums=0)  # expect: PL006
+        outs.append(step(f))
+        sup = jax.jit(f)  # pertlint: disable=PL006
+        outs.append(sup)
+    comp = [jax.jit(f) for f in fns]  # expect: PL006
+    while xs:
+        g = jax.jit(fns[0])  # expect: PL006
+        xs = xs[:-1]
+    return outs, comp, g

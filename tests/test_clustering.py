@@ -121,6 +121,62 @@ def test_discover_clones_custom_cell_col():
     assert (purity > 0.9).all()
 
 
+def test_discover_clones_overwrites_preexisting_cluster_id():
+    """Re-running inference on a previous run's output (which already
+    carries cluster_id) must overwrite it, not suffix to _x/_y and
+    KeyError downstream (ADVICE.md round 5)."""
+    from scdna_replication_tools_tpu.pipeline.clustering import (
+        discover_clones,
+    )
+    frame, _ = _blob_frame()
+    long = (frame.reset_index(names="start")
+            .melt(id_vars="start", var_name="cell_id", value_name="copy"))
+    long["chr"] = "1"
+    long["cluster_id"] = 99            # stale labels from a previous run
+    out, clone_col = discover_clones(long, "copy", method="kmeans",
+                                     min_k=2, max_k=4)
+    assert clone_col == "cluster_id"
+    assert "cluster_id" in out.columns
+    assert not any(c.startswith("cluster_id_") for c in out.columns)
+    assert (out["cluster_id"] != 99).any()   # fresh labels, not the stale 99
+    assert len(out) == len(long)
+
+
+def test_spectral_embed_sparse_path_on_disconnected_graph():
+    """The ARPACK shift-invert path (forced via dense_cutoff) must handle
+    a kNN graph with multiple components — the normalized Laplacian then
+    has a multiplicity->1 zero eigenvalue, which the old sigma=0.0
+    shift-invert handed to SuperLU as an exactly singular factorization
+    (ADVICE.md round 5)."""
+    frame, truth = _blob_frame(n_per_blob=50, n_loci=30, seed=3)
+    X = frame.T.values
+    # n_neighbors small vs the blob size: the symmetrised kNN graph of
+    # three well-separated blobs disconnects into 3 components
+    emb = spectral_embed(X, n_components=2, n_neighbors=5, dense_cutoff=16)
+    assert emb.shape == (X.shape[0], 2)
+    assert np.all(np.isfinite(emb))
+
+
+def test_spectral_embed_dense_fallback_on_solver_failure(monkeypatch):
+    """When ARPACK/SuperLU still fails, the dense-eigh fallback keeps
+    clone discovery alive (and produces the same embedding family)."""
+    import scipy.sparse.linalg
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("Factor is exactly singular")
+
+    monkeypatch.setattr(scipy.sparse.linalg, "eigsh", boom)
+    frame, _ = _blob_frame(n_per_blob=30, n_loci=30, seed=4)
+    X = frame.T.values
+    emb = spectral_embed(X, n_components=2, n_neighbors=8, dense_cutoff=16)
+    assert emb.shape == (X.shape[0], 2)
+    assert np.all(np.isfinite(emb))
+    # the fallback must agree with the small-n dense path bit-for-bit
+    # (same Laplacian, same solver)
+    dense = spectral_embed(X, n_components=2, n_neighbors=8)
+    assert np.array_equal(emb, dense)
+
+
 def test_kmeans_cluster_still_recovers_blobs():
     frame, truth = _blob_frame()
     out = kmeans_cluster(frame, min_k=2, max_k=5)

@@ -1,0 +1,310 @@
+"""pertlint: detection, suppression, baseline workflow, and the CI gate.
+
+Pure stdlib + tools.pertlint — no jax/numpy/pandas imports — so the CI
+lint job can run this module with a bare interpreter.
+
+Fixture convention (tests/pertlint_fixtures/): each rule has one fixture
+module, parsed but never imported.  A line ending in ``# expect: PLnnn``
+must produce exactly that finding; a line carrying
+``# pertlint: disable=PLnnn`` must land in the suppressed list.  The
+fixtures double as living documentation of each rule's exemptions.
+"""
+
+import json
+import pathlib
+import re
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO_ROOT))
+
+from tools.pertlint import lint_paths, lint_source  # noqa: E402
+from tools.pertlint.cli import main as cli_main  # noqa: E402
+from tools.pertlint.core import all_rules  # noqa: E402
+from tools.pertlint.engine import snapshot_baseline  # noqa: E402
+
+FIXTURE_DIR = REPO_ROOT / "tests" / "pertlint_fixtures"
+PACKAGE = REPO_ROOT / "scdna_replication_tools_tpu"
+BASELINE = REPO_ROOT / "tools" / "pertlint" / "baseline.json"
+
+_EXPECT = re.compile(r"#\s*expect:\s*(PL\d{3})")
+
+FIXTURES = {
+    "PL001": FIXTURE_DIR / "pl001_host_sync.py",
+    "PL002": FIXTURE_DIR / "pl002_tracer_branch.py",
+    "PL003": FIXTURE_DIR / "pl003_partition_spec.py",
+    "PL004": FIXTURE_DIR / "ops" / "pl004_dtype_drift.py",
+    "PL005": FIXTURE_DIR / "pl005_rng.py",
+    "PL006": FIXTURE_DIR / "pl006_jit_in_loop.py",
+}
+
+
+def _lint_fixture(path):
+    source = path.read_text()
+    findings, suppressed = lint_source(source, path=path.as_posix())
+    return source, findings, suppressed
+
+
+def test_every_rule_has_a_fixture():
+    assert set(FIXTURES) == {r.id for r in all_rules()}
+
+
+@pytest.mark.parametrize("rule_id", sorted(FIXTURES))
+def test_rule_detections_match_expect_markers(rule_id):
+    """Findings == the fixture's ``# expect:`` markers, line-exact."""
+    source, findings, suppressed = _lint_fixture(FIXTURES[rule_id])
+    expected = {i for i, line in enumerate(source.splitlines(), start=1)
+                if (m := _EXPECT.search(line)) and m.group(1) == rule_id}
+    assert expected, "fixture must seed at least one violation"
+    actual = {f.line for f in findings if f.rule == rule_id}
+    assert actual == expected
+    # no OTHER rule may fire on this fixture's expect lines (isolation)
+    cross = {f.rule for f in findings} - {rule_id}
+    assert not cross, f"unexpected cross-rule findings: {cross}"
+
+
+@pytest.mark.parametrize("rule_id", sorted(FIXTURES))
+def test_rule_suppression_lines_are_suppressed(rule_id):
+    """Each fixture's inline-disable line produces a suppressed finding —
+    proving the violation was detected AND the comment ate it."""
+    source, findings, suppressed = _lint_fixture(FIXTURES[rule_id])
+    disable_lines = {i for i, line in enumerate(source.splitlines(), 1)
+                     if f"pertlint: disable={rule_id}" in line}
+    assert disable_lines, "fixture must carry a suppressed case"
+    assert disable_lines == {s.line for s in suppressed if s.rule == rule_id}
+    assert not ({f.line for f in findings} & disable_lines)
+
+
+def test_suppression_marker_in_string_literal_is_inert():
+    src = textwrap.dedent("""\
+        import numpy as np
+        MSG = "# pertlint: disable=PL005"
+        def f(n):
+            return np.random.rand(n), MSG
+        """)
+    findings, suppressed = lint_source(src)
+    assert [f.rule for f in findings] == ["PL005"]
+    assert not suppressed
+
+
+def test_malformed_suppression_markers_fail_closed():
+    """A typo'd keyword or an invalid rule list must suppress NOTHING —
+    widening to all rules would turn a typo into a disabled gate."""
+    body = "import numpy as np\ndef f(n):\n    return np.random.rand(n)"
+    for marker in ("# pertlint: disable-files=PL005",   # keyword typo
+                   "# pertlint: disable=bogus",          # no valid rule id
+                   "# pertlint: disabled=PL005"):        # keyword typo
+        src = body.replace("np.random.rand(n)",
+                           f"np.random.rand(n)  {marker}")
+        findings, suppressed = lint_source(src)
+        assert [f.rule for f in findings] == ["PL005"], marker
+        assert not suppressed, marker
+
+
+def test_suppression_rule_ids_are_case_normalised():
+    src = ("import numpy as np\ndef f(n):\n"
+           "    return np.random.rand(n)  # pertlint: disable=pl005\n")
+    findings, suppressed = lint_source(src)
+    assert not findings
+    assert [s.rule for s in suppressed] == ["PL005"]
+
+
+def test_local_assignment_does_not_taint_same_named_helper():
+    """A Store-context name inside a jitted function must not mark a
+    same-named module-level host helper as traced (PL001 false
+    positive)."""
+    src = textwrap.dedent("""\
+        import jax
+        import numpy as np
+
+        def report(x):
+            return float(np.asarray(x).mean())   # host-only: legal
+
+        @jax.jit
+        def step(x):
+            report = x * 2.0                     # local, shadows nothing
+            return report
+        """)
+    findings, _ = lint_source(src)
+    assert findings == []
+
+
+def test_file_wide_suppression():
+    src = textwrap.dedent("""\
+        # pertlint: disable-file=PL005 — fixture-wide opt-out
+        import numpy as np
+        def f(n):
+            return np.random.rand(n) + np.random.randn(n)
+        """)
+    findings, suppressed = lint_source(src)
+    assert not findings
+    assert {s.rule for s in suppressed} == {"PL005"}
+
+
+@pytest.mark.parametrize("rule_id", sorted(FIXTURES))
+def test_rule_baseline_roundtrip(rule_id, tmp_path):
+    """Baseline workflow per rule: snapshot grandfathers every finding;
+    a freshly added violation still gates."""
+    fixture = FIXTURES[rule_id]
+    work = tmp_path / ("ops" if rule_id == "PL004" else "lib")
+    work.mkdir()
+    target = work / fixture.name
+    target.write_text(fixture.read_text())
+    baseline = tmp_path / "baseline.json"
+
+    n = snapshot_baseline([str(work)], baseline)
+    assert n > 0
+    clean = lint_paths([str(work)], baseline_path=baseline)
+    assert clean.new == [] and len(clean.baselined) == n
+
+    with target.open("a") as fh:
+        fh.write(_seed_violation(rule_id))
+    dirty = lint_paths([str(work)], baseline_path=baseline)
+    assert [f.rule for f in dirty.new] == [rule_id]
+    assert len(dirty.baselined) == n
+
+
+def _seed_violation(rule_id):
+    return {
+        "PL001": "\n@jax.jit\ndef seeded(x):\n    return float(x)\n",
+        "PL002": ("\n@jax.jit\ndef seeded(x):\n"
+                  "    if jnp.isnan(x).any():\n        x = x * 0\n"
+                  "    return x\n"),
+        "PL003": "\ndef seeded():\n    return P('cells')\n",
+        "PL004": "\ndef seeded(n):\n    return jnp.zeros((n,))\n",
+        "PL005": "\ndef seeded(n):\n    return np.random.rand(n)\n",
+        "PL006": ("\ndef seeded(fns):\n    for f in fns:\n"
+                  "        g = jax.jit(f)\n    return g\n"),
+    }[rule_id]
+
+
+def test_baseline_fingerprint_survives_line_shift(tmp_path):
+    """Inserting unrelated lines above a baselined finding must not
+    resurrect it (fingerprints are content-addressed, not line-keyed)."""
+    target = tmp_path / "mod.py"
+    target.write_text("import numpy as np\n"
+                      "def f(n):\n    return np.random.rand(n)\n")
+    baseline = tmp_path / "baseline.json"
+    snapshot_baseline([str(target)], baseline)
+    target.write_text("import numpy as np\n\n# a comment\n\n"
+                      "def g():\n    return 1\n\n"
+                      "def f(n):\n    return np.random.rand(n)\n")
+    result = lint_paths([str(target)], baseline_path=baseline)
+    assert result.new == [] and len(result.baselined) == 1
+
+
+def test_partial_snapshot_retains_out_of_scope_entries(tmp_path):
+    """--write-baseline over a path subset must keep the grandfathered
+    entries of every other path (no silent baseline data loss)."""
+    a, b = tmp_path / "a", tmp_path / "b"
+    a.mkdir(); b.mkdir()
+    (a / "m.py").write_text("import numpy as np\n"
+                            "def f(n):\n    return np.random.rand(n)\n")
+    (b / "m.py").write_text("import numpy as np\n"
+                            "def g(n):\n    return np.random.randn(n)\n")
+    baseline = tmp_path / "baseline.json"
+    assert snapshot_baseline([str(a), str(b)], baseline) == 2
+    # re-snapshot ONLY a/ — b/'s entry must survive, and the full-tree
+    # lint must still be clean against the rewritten baseline
+    assert snapshot_baseline([str(a)], baseline) == 2
+    result = lint_paths([str(a), str(b)], baseline_path=baseline)
+    assert result.new == [] and len(result.baselined) == 2
+    # pruning still works within the snapshot scope: fix a/ and re-write
+    (a / "m.py").write_text("def f(n):\n    return n\n")
+    assert snapshot_baseline([str(a)], baseline) == 1
+
+
+def test_write_baseline_with_select_is_refused(tmp_path, capsys):
+    target = tmp_path / "m.py"
+    target.write_text("def f():\n    return 1\n")
+    rc = cli_main([str(target), "--write-baseline", "--select", "PL005",
+                   "--baseline", str(tmp_path / "b.json")])
+    assert rc == 2
+    assert "--select" in capsys.readouterr().err
+
+
+def test_stale_baseline_entries_reported(tmp_path):
+    target = tmp_path / "mod.py"
+    target.write_text("import numpy as np\n"
+                      "def f(n):\n    return np.random.rand(n)\n")
+    baseline = tmp_path / "baseline.json"
+    snapshot_baseline([str(target)], baseline)
+    target.write_text("import numpy as np\n"
+                      "def f(n, rng):\n    return rng.random(n)\n")
+    result = lint_paths([str(target)], baseline_path=baseline)
+    assert result.new == [] and len(result.stale_baseline) == 1
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    clean = tmp_path / "clean.py"
+    clean.write_text("def f():\n    return 1\n")
+    assert cli_main([str(clean), "--no-baseline"]) == 0
+
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("import numpy as np\n"
+                     "def f(n):\n    return np.random.rand(n)\n")
+    assert cli_main([str(dirty), "--no-baseline"]) == 1
+    out = capsys.readouterr().out
+    assert "PL005" in out and "dirty.py:3" in out
+
+    assert cli_main([]) == 2                       # no paths
+    assert cli_main([str(clean), "--select", "PL999"]) == 2
+    assert cli_main(["--list-rules"]) == 0
+    assert "PL001" in capsys.readouterr().out
+
+
+def test_cli_select_and_json_format(tmp_path, capsys):
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("import numpy as np\n"
+                     "def f(n):\n    return np.random.rand(n)\n")
+    # selecting an unrelated rule: the PL005 violation is not even run
+    assert cli_main([str(dirty), "--no-baseline", "--select", "PL006"]) == 0
+    capsys.readouterr()
+    assert cli_main([str(dirty), "--no-baseline", "--format", "json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["new"][0]["rule"] == "PL005"
+    assert payload["files_checked"] == 1
+
+
+def test_cli_parse_error_exits_2(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(:\n")
+    assert cli_main([str(bad), "--no-baseline"]) == 2
+
+
+def test_package_gate_is_clean():
+    """THE gate: the shipped tree + shipped baseline lints clean.  Run
+    exactly as CI does — ``python -m tools.pertlint <package>``."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.pertlint",
+         "scdna_replication_tools_tpu", "--baseline",
+         str(BASELINE)],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_seeded_violation_fails_the_gate(tmp_path):
+    """Acceptance criterion: introducing a violation (a float() on a
+    traced value inside a jitted helper, and a PartitionSpec outside
+    layout.py) flips the module CLI to a non-zero exit."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "seeded.py").write_text(textwrap.dedent("""\
+        import jax
+        from jax.sharding import PartitionSpec
+
+        @jax.jit
+        def step(x):
+            return float(x)
+
+        SPEC = PartitionSpec("cells")
+        """))
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.pertlint", str(pkg), "--no-baseline"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "PL001" in proc.stdout and "PL003" in proc.stdout

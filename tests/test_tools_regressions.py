@@ -1,0 +1,87 @@
+"""Regression tests for the repo tooling (ADVICE.md round 5 + BENCH_r05).
+
+* ``tools/accuracy_sweep.py`` — the end-of-run summary used to crash
+  with TypeError when any config's metric was None (min() over Nones),
+  losing the summary line AFTER all the compute was spent.
+* ``bench.py`` — the bare harness invocation timed out (BENCH_r05
+  rc=124, nothing parsed); the --budget preset layer keeps the default
+  fast while ``--budget full`` preserves the production-shaped problem.
+
+Both modules are import-light at top level (no jax/torch until main()),
+so these tests stay in the fast tier.
+"""
+
+import importlib.util
+import pathlib
+import sys
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _load(name, relpath):
+    spec = importlib.util.spec_from_file_location(name, REPO_ROOT / relpath)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_sweep_summary_filters_none_metrics():
+    sweep = _load("accuracy_sweep_under_test", "tools/accuracy_sweep.py")
+    results = [
+        {"rep_accuracy": 0.93}, {"rep_accuracy": None},
+        {"rep_accuracy": 0.88},
+    ]
+    s = sweep.summarize(results)
+    assert s == {"configs_run": 3, "min_rep_accuracy": 0.88,
+                 "configs_without_accuracy": 1}
+
+
+def test_sweep_summary_all_none_is_well_defined():
+    sweep = _load("accuracy_sweep_under_test", "tools/accuracy_sweep.py")
+    s = sweep.summarize([{"rep_accuracy": None}])
+    assert s["min_rep_accuracy"] is None
+    assert s["configs_without_accuracy"] == 1
+    assert s["configs_run"] == 1
+
+
+def test_sweep_summary_empty_results():
+    sweep = _load("accuracy_sweep_under_test", "tools/accuracy_sweep.py")
+    s = sweep.summarize([])
+    assert s["configs_run"] == 0 and s["min_rep_accuracy"] is None
+
+
+def test_bench_default_budget_is_fast():
+    bench = _load("bench_under_test", "bench.py")
+    args = bench._parse_args([])
+    assert args.budget == "fast"
+    assert (args.cells, args.loci, args.iters) == (256, 1024, 50)
+    assert args.baseline_iters == 5 and args.probe_timeout == 60
+
+
+def test_bench_full_budget_restores_production_shape():
+    bench = _load("bench_under_test", "bench.py")
+    args = bench._parse_args(["--budget", "full"])
+    assert (args.cells, args.loci, args.iters) == (1000, 5451, 100)
+    assert args.baseline_iters == 20 and args.probe_timeout == 150
+
+
+def test_bench_explicit_args_beat_the_preset():
+    bench = _load("bench_under_test", "bench.py")
+    args = bench._parse_args(["--cells", "77", "--probe-timeout", "5"])
+    assert args.cells == 77 and args.probe_timeout == 5
+    assert args.loci == 1024        # unspecified -> fast preset still fills
+
+
+def test_bench_presets_cover_every_sentinel_arg():
+    """Every None-defaulted size arg must be filled by BOTH presets, or a
+    bare run would crash on a None size."""
+    bench = _load("bench_under_test", "bench.py")
+    for budget in bench.BUDGETS:
+        args = bench._parse_args(["--budget", budget])
+        for name in ("cells", "loci", "iters", "baseline_iters",
+                     "probe_timeout"):
+            assert getattr(args, name) is not None, (budget, name)
+
+
+if __name__ == "__main__":
+    sys.exit(0)

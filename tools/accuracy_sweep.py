@@ -51,6 +51,23 @@ def _tutorial():
     return _TUTORIAL_MOD
 
 
+def summarize(results):
+    """End-of-run summary line (JSON-ready dict).
+
+    ``rep_accuracy`` is None for configs whose metric came out non-finite
+    (empty/degenerate output frame); min() over a None-bearing list
+    raises TypeError, which used to crash the sweep AFTER all the work
+    was done — filter the Nones and surface how many were dropped.
+    """
+    accs = [r["rep_accuracy"] for r in results
+            if r.get("rep_accuracy") is not None]
+    return {
+        "configs_run": len(results),
+        "min_rep_accuracy": min(accs) if accs else None,
+        "configs_without_accuracy": len(results) - len(accs),
+    }
+
+
 def _round_or_none(x, nd=4):
     """NaN-safe metric for the JSON artifact (bare NaN tokens break
     strict RFC 8259 parsers)."""
@@ -142,9 +159,7 @@ def main(argv=None):
     if args.out:
         with open(args.out, "w") as fh:
             json.dump(out, fh, indent=1)
-    print(json.dumps({"configs_run": len(results),
-                      "min_rep_accuracy": min(r["rep_accuracy"]
-                                              for r in results)}))
+    print(json.dumps(summarize(results)))
     return out
 
 

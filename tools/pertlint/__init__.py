@@ -1,0 +1,22 @@
+"""pertlint: JAX/TPU-aware static analysis for the PERT port.
+
+The Pyro reference only needed a ``cuda`` flag; the TPU path depends on
+invariants XLA never checks for us — no host syncs inside compiled
+loops, no Python control flow on tracers, shardings owned by
+``layout.py``, f32-stable dtypes in the enumeration kernel.  pertlint
+encodes each invariant as an AST rule (PL001..PL006) and gates CI:
+
+    python -m tools.pertlint scdna_replication_tools_tpu
+
+exits non-zero on any violation that is neither inline-suppressed
+(``# pertlint: disable=RULE``) nor grandfathered in the checked-in
+baseline (``tools/pertlint/baseline.json``).
+
+Pure stdlib (``ast`` + ``tokenize``): importable and runnable with no
+jax/numpy installed, so the CI lint job stays seconds-fast.
+"""
+
+from tools.pertlint.core import Finding, Rule, all_rules  # noqa: F401
+from tools.pertlint.engine import lint_paths, lint_source  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,5 @@
+import sys
+
+from tools.pertlint.cli import main
+
+sys.exit(main())

@@ -1,0 +1,88 @@
+"""Baseline file: grandfathered findings that do not gate the build.
+
+The baseline is a checked-in JSON list of finding fingerprints.  A
+fingerprint is content-addressed — ``sha1(rule : path : stripped source
+line : occurrence-index)`` — so it survives unrelated edits that shift
+line numbers, and only breaks when the flagged line itself changes
+(at which point the finding deserves a fresh look).
+
+Workflow: ``python -m tools.pertlint <paths> --write-baseline`` snapshots
+every current finding; subsequent runs report (and gate on) only
+findings that are NOT in the snapshot.  Stale entries — fingerprints no
+longer produced by the tree — are reported so the baseline shrinks as
+debt is paid down; ``--write-baseline`` prunes them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import pathlib
+from typing import Dict, Iterable, List, Set, Tuple
+
+from tools.pertlint.core import Finding
+
+BASELINE_VERSION = 1
+
+
+def fingerprint(finding: Finding, line_text: str, occurrence: int) -> str:
+    payload = f"{finding.rule}:{finding.path}:{line_text.strip()}:{occurrence}"
+    return hashlib.sha1(payload.encode()).hexdigest()[:16]
+
+
+def fingerprint_findings(findings: Iterable[Finding],
+                         sources: Dict[str, List[str]]
+                         ) -> List[Tuple[Finding, str]]:
+    """Pair each finding with its fingerprint.
+
+    ``sources`` maps path -> source lines.  Identical flagged lines in
+    the same file get distinct occurrence indices (in line order) so two
+    copies of a violation need two baseline entries.
+    """
+    seen: Dict[Tuple[str, str, str], int] = {}
+    out = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule)):
+        lines = sources.get(f.path, [])
+        text = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+        key = (f.rule, f.path, text.strip())
+        occurrence = seen.get(key, 0)
+        seen[key] = occurrence + 1
+        out.append((f, fingerprint(f, text, occurrence)))
+    return out
+
+
+def load_entries(path: pathlib.Path) -> List[dict]:
+    """Raw entry dicts of a baseline file; missing file = empty baseline."""
+    if not path.is_file():
+        return []
+    data = json.loads(path.read_text())
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(f"{path}: unsupported baseline version "
+                         f"{data.get('version')!r}")
+    return list(data.get("findings", []))
+
+
+def load(path: pathlib.Path) -> Set[str]:
+    """Fingerprint set of a baseline file; missing file = empty baseline."""
+    return {e["fingerprint"] for e in load_entries(path)}
+
+
+def write(path: pathlib.Path,
+          fingerprinted: List[Tuple[Finding, str]],
+          retained_entries: List[dict] = ()) -> None:
+    """Write retained (out-of-scope) entries + the fresh snapshot.
+
+    ``retained_entries`` are prior entries for paths NOT covered by the
+    snapshot run — a partial-tree ``--write-baseline`` must not silently
+    drop the rest of the grandfathered debt.
+    """
+    entries = list(retained_entries) + [
+        {"rule": f.rule, "path": f.path, "line": f.line,
+         "fingerprint": fp, "message": f.message}
+        for f, fp in fingerprinted]
+    entries.sort(key=lambda e: (e["path"], e["line"], e["rule"]))
+    path.write_text(json.dumps(
+        {"version": BASELINE_VERSION,
+         "note": "grandfathered pertlint findings; regenerate with "
+                 "--write-baseline (see tools/pertlint/README.md)",
+         "findings": entries}, indent=1) + "\n")

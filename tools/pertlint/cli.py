@@ -1,0 +1,124 @@
+"""``python -m tools.pertlint`` — the CI gate.
+
+Exit codes: 0 clean (no new error-severity findings), 1 new violations,
+2 usage/parse errors.  ``--write-baseline`` snapshots the current
+findings as grandfathered; ``--no-baseline`` ignores the baseline file
+(shows the whole debt).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+from typing import List, Optional
+
+from tools.pertlint.core import all_rules
+from tools.pertlint.engine import lint_paths, snapshot_baseline
+
+DEFAULT_BASELINE = pathlib.Path(__file__).parent / "baseline.json"
+
+
+def _list_rules() -> str:
+    lines = ["pertlint rules:"]
+    for rule in all_rules():
+        lines.append(f"  {rule.id}  {rule.name:<20} [{rule.severity}] "
+                     f"{rule.description}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.pertlint",
+        description="JAX/TPU-aware static analysis for the PERT port "
+                    "(see tools/pertlint/README.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories to lint "
+                         "(e.g. scdna_replication_tools_tpu)")
+    ap.add_argument("--baseline", type=pathlib.Path,
+                    default=DEFAULT_BASELINE,
+                    help="baseline file of grandfathered findings "
+                         "(default: %(default)s; missing file = empty)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline; report the full debt")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="snapshot current findings into --baseline and "
+                         "exit 0")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--format", choices=["text", "json"], default="text")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        print(_list_rules())
+        return 0
+    if not args.paths:
+        ap.print_usage(sys.stderr)
+        print("error: no paths given", file=sys.stderr)
+        return 2
+
+    rules = all_rules()
+    if args.select:
+        wanted = {r.strip() for r in args.select.split(",") if r.strip()}
+        unknown = wanted - {r.id for r in rules}
+        if unknown:
+            print(f"error: unknown rule ids {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.id in wanted]
+
+    if args.write_baseline:
+        if args.select:
+            # a rule-subset snapshot would rebuild the covered paths'
+            # entries with the unselected rules' findings dropped —
+            # silent baseline data loss; snapshot with the full rule set
+            print("error: --write-baseline cannot be combined with "
+                  "--select (it would drop the unselected rules' "
+                  "grandfathered entries)", file=sys.stderr)
+            return 2
+        n = snapshot_baseline(args.paths, args.baseline, rules=rules)
+        print(f"pertlint: baseline written to {args.baseline} "
+              f"({n} grandfathered finding{'s' if n != 1 else ''}; "
+              f"entries outside the given paths retained)")
+        return 0
+
+    baseline = None if args.no_baseline else args.baseline
+    result = lint_paths(args.paths, baseline_path=baseline, rules=rules)
+
+    if args.format == "json":
+        print(json.dumps({
+            "files_checked": result.files_checked,
+            "new": [vars(f) for f in result.new],
+            "baselined": len(result.baselined),
+            "suppressed": len(result.suppressed),
+            "stale_baseline": sorted(result.stale_baseline),
+            "parse_errors": result.parse_errors,
+        }, indent=1))
+    else:
+        for f in result.new:
+            print(f.render())
+        for path, msg in result.parse_errors:
+            print(f"{path}:1:0: parse-error {msg}", file=sys.stderr)
+        if result.stale_baseline:
+            print(f"pertlint: note: {len(result.stale_baseline)} stale "
+                  f"baseline entr{'ies' if len(result.stale_baseline) != 1 else 'y'} "
+                  f"(fixed or edited) — run --write-baseline to prune",
+                  file=sys.stderr)
+        gating = result.gating
+        warnings = len(result.new) - len(gating)
+        print(f"pertlint: {result.files_checked} files, "
+              f"{len(gating)} new violation{'s' if len(gating) != 1 else ''}"
+              + (f" + {warnings} warning{'s' if warnings != 1 else ''}"
+                 if warnings else "")
+              + f" ({len(result.baselined)} baselined, "
+                f"{len(result.suppressed)} suppressed)")
+
+    if result.parse_errors:
+        return 2
+    return 1 if result.gating else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

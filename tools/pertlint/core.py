@@ -1,0 +1,67 @@
+"""Rule registry and the Finding record every rule emits.
+
+A rule is a class with a stable ``id`` (``PLnnn``), a ``severity``
+(``error`` gates the build; ``warning`` is reported but never flips the
+exit code on its own — the knob exists so a new rule can soak before it
+gates), and a ``check(ctx)`` generator yielding :class:`Finding`.
+Registration is a decorator so each rule module is self-contained and
+``rules/__init__.py`` only has to import them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, List, Type
+
+SEVERITIES = ("error", "warning")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str       # "PL001"
+    severity: str   # "error" | "warning"
+    path: str       # posix path as given to the engine (repo-relative in CI)
+    line: int       # 1-based, the AST node's lineno
+    col: int        # 0-based
+    message: str
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule} [{self.severity}] {self.message}")
+
+
+class Rule:
+    """Base class; subclasses set the class attributes and ``check``."""
+
+    id: str = ""
+    name: str = ""
+    severity: str = "error"
+    description: str = ""
+
+    def check(self, ctx) -> Iterable[Finding]:  # ctx: engine.FileContext
+        raise NotImplementedError
+
+    def finding(self, ctx, node, message: str) -> Finding:
+        return Finding(rule=self.id, severity=self.severity, path=ctx.path,
+                       line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0), message=message)
+
+
+_REGISTRY: Dict[str, Type[Rule]] = {}
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    if not cls.id or not cls.id.startswith("PL"):
+        raise ValueError(f"rule {cls.__name__} needs a PLnnn id")
+    if cls.severity not in SEVERITIES:
+        raise ValueError(f"rule {cls.id}: bad severity {cls.severity!r}")
+    if cls.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {cls.id}")
+    _REGISTRY[cls.id] = cls
+    return cls
+
+
+def all_rules() -> List[Rule]:
+    """Fresh instances of every registered rule, id-ordered."""
+    import tools.pertlint.rules  # noqa: F401 — importing registers them
+    return [_REGISTRY[rid]() for rid in sorted(_REGISTRY)]

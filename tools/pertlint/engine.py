@@ -1,0 +1,172 @@
+"""File walking, per-file rule execution, suppression + baseline filtering.
+
+``lint_paths`` is the programmatic entry the CLI and the test gate share:
+it returns a :class:`LintResult` whose ``new`` list is what gates the
+build (error-severity findings that are neither suppressed inline nor
+baselined).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import functools
+import pathlib
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.pertlint import baseline as baseline_mod
+from tools.pertlint import jitgraph, suppress
+from tools.pertlint.core import Finding, Rule, all_rules
+
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules", "build",
+              "dist", ".eggs"}
+
+
+@dataclasses.dataclass
+class FileContext:
+    """Everything a rule may need about one file; shared analyses cached."""
+    path: str                 # as reported in findings (posix, as given)
+    source: str
+    lines: List[str]
+    tree: ast.Module
+
+    @functools.cached_property
+    def traced(self) -> jitgraph.TracedInfo:
+        return jitgraph.compute_traced(self.tree)
+
+    @functools.cached_property
+    def numpy_aliases(self) -> Set[str]:
+        return jitgraph.numpy_aliases(self.tree)
+
+    @functools.cached_property
+    def jnp_aliases(self) -> Set[str]:
+        return jitgraph.jnp_aliases(self.tree)
+
+    @functools.cached_property
+    def lax_aliases(self) -> Set[str]:
+        return jitgraph.lax_aliases(self.tree)
+
+    @functools.cached_property
+    def parents(self) -> Dict[ast.AST, ast.AST]:
+        return {child: parent for parent in ast.walk(self.tree)
+                for child in ast.iter_child_nodes(parent)}
+
+
+@dataclasses.dataclass
+class LintResult:
+    new: List[Finding]                  # gate: not suppressed, not baselined
+    baselined: List[Finding]
+    suppressed: List[Finding]
+    stale_baseline: Set[str]            # fingerprints no tree finding matches
+    parse_errors: List[Tuple[str, str]]  # (path, message)
+    files_checked: int = 0
+
+    @property
+    def gating(self) -> List[Finding]:
+        return [f for f in self.new if f.severity == "error"]
+
+
+def iter_python_files(paths: Sequence[str]) -> List[pathlib.Path]:
+    out: List[pathlib.Path] = []
+    for raw in paths:
+        p = pathlib.Path(raw)
+        if p.is_file() and p.suffix == ".py":
+            out.append(p)
+        elif p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if not any(part in _SKIP_DIRS for part in f.parts):
+                    out.append(f)
+    return out
+
+
+def lint_source(source: str, path: str = "<string>",
+                rules: Optional[Sequence[Rule]] = None
+                ) -> Tuple[List[Finding], List[Finding]]:
+    """Lint one source blob -> (findings, suppressed).  Test seam."""
+    rules = list(rules) if rules is not None else all_rules()
+    tree = ast.parse(source, filename=path)
+    ctx = FileContext(path=path, source=source,
+                      lines=source.splitlines(), tree=tree)
+    per_line, file_wide = suppress.parse_suppressions(source)
+    kept: List[Finding] = []
+    dropped: List[Finding] = []
+    for rule in rules:
+        for finding in rule.check(ctx):
+            if suppress.is_suppressed(finding.rule, finding.line, per_line,
+                                      file_wide):
+                dropped.append(finding)
+            else:
+                kept.append(finding)
+    key = lambda f: (f.line, f.col, f.rule)  # noqa: E731
+    return sorted(set(kept), key=key), sorted(set(dropped), key=key)
+
+
+def lint_paths(paths: Sequence[str],
+               baseline_path: Optional[pathlib.Path] = None,
+               rules: Optional[Sequence[Rule]] = None) -> LintResult:
+    rules = list(rules) if rules is not None else all_rules()
+    known = baseline_mod.load(baseline_path) if baseline_path else set()
+
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+    parse_errors: List[Tuple[str, str]] = []
+    sources: Dict[str, List[str]] = {}
+    files = iter_python_files(paths)
+    for f in files:
+        path = f.as_posix()
+        try:
+            source = f.read_text()
+            kept, dropped = lint_source(source, path=path, rules=rules)
+        except (SyntaxError, UnicodeDecodeError) as exc:
+            parse_errors.append((path, f"{type(exc).__name__}: {exc}"))
+            continue
+        sources[path] = source.splitlines()
+        findings.extend(kept)
+        suppressed.extend(dropped)
+
+    fingerprinted = baseline_mod.fingerprint_findings(findings, sources)
+    new = [f for f, fp in fingerprinted if fp not in known]
+    baselined = [f for f, fp in fingerprinted if fp in known]
+    stale = known - {fp for _, fp in fingerprinted}
+    return LintResult(new=new, baselined=baselined, suppressed=suppressed,
+                      stale_baseline=stale, parse_errors=parse_errors,
+                      files_checked=len(files))
+
+
+def _covered_by(entry_path: str, roots: Sequence[str]) -> bool:
+    """Does ``entry_path`` fall under any of the snapshot roots?"""
+    ep = pathlib.PurePosixPath(pathlib.Path(entry_path).as_posix())
+    for raw in roots:
+        rp = pathlib.PurePosixPath(pathlib.Path(raw).as_posix())
+        if ep == rp or str(ep).startswith(str(rp).rstrip("/") + "/"):
+            return True
+    return False
+
+
+def snapshot_baseline(paths: Sequence[str],
+                      baseline_path: pathlib.Path,
+                      rules: Optional[Sequence[Rule]] = None) -> int:
+    """Write the baseline from the tree's current findings; -> count.
+
+    Entries for paths OUTSIDE ``paths`` are retained untouched, so a
+    partial-tree snapshot grandfathers new findings without silently
+    dropping the rest of the debt (entries under ``paths`` are fully
+    rebuilt — that is what prunes stale ones).
+    """
+    rules = list(rules) if rules is not None else all_rules()
+    findings: List[Finding] = []
+    sources: Dict[str, List[str]] = {}
+    for f in iter_python_files(paths):
+        path = f.as_posix()
+        try:
+            source = f.read_text()
+            kept, _ = lint_source(source, path=path, rules=rules)
+        except (SyntaxError, UnicodeDecodeError):
+            continue
+        sources[path] = source.splitlines()
+        findings.extend(kept)
+    fingerprinted = baseline_mod.fingerprint_findings(findings, sources)
+    retained = [e for e in baseline_mod.load_entries(baseline_path)
+                if not _covered_by(e["path"], paths)]
+    baseline_mod.write(baseline_path, fingerprinted, retained)
+    return len(fingerprinted) + len(retained)

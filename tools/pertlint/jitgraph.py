@@ -1,0 +1,260 @@
+"""Module-local traced-code reachability for the jit-aware rules.
+
+PL001/PL002 only make sense inside code that XLA traces.  This module
+computes, per file, the set of function nodes that are *traced-reachable*:
+
+* functions decorated with ``jax.jit`` / ``jit`` / ``pjit`` /
+  ``shard_map`` — directly or via ``functools.partial(jax.jit, ...)``;
+* functions passed to a jit/shard_map call expression
+  (``fn = jax.jit(step)``, ``shard_map(kernel, mesh, ...)``);
+* functions lexically nested inside a traced function (``cond``/``body``
+  closures of ``lax.while_loop`` etc.);
+* fixpoint closure over same-module calls: a plain function called by
+  name from a traced function body is traced too.
+
+The analysis is deliberately module-local — cross-module call graphs
+buy little here (the package's jit entry points wrap same-module helpers)
+and would make the tool's verdicts hard to predict for a reader of one
+file.  ``static_argnames`` of the jit decoration are recorded so rules
+can exempt Python-level arguments (``float(max_iter)`` is not a sync).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+_JIT_NAMES = {"jit", "pjit"}
+_SHARD_NAMES = {"shard_map"}
+_WRAPPER_NAMES = _JIT_NAMES | _SHARD_NAMES
+
+
+def _tail_name(expr: ast.AST) -> Optional[str]:
+    """'jit' for ``jit`` / ``jax.jit`` / ``jax.experimental.pjit.pjit``."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def is_wrapper_expr(expr: ast.AST) -> bool:
+    """Is ``expr`` (not a call) a jit/shard_map callable reference?"""
+    return _tail_name(expr) in _WRAPPER_NAMES
+
+
+def _static_argnames(call: ast.Call) -> Set[str]:
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(node.value,
+                                                                 str):
+                    names.add(node.value)
+    return names
+
+
+def _wrapper_call_info(call: ast.Call) -> Optional[Set[str]]:
+    """If ``call`` builds a jit/shard_map wrapper, its static argnames.
+
+    Matches ``jax.jit(...)``, ``shard_map(...)`` and the decorator-factory
+    spelling ``functools.partial(jax.jit, ...)``.  Returns None when the
+    call is unrelated.
+    """
+    if is_wrapper_expr(call.func):
+        return _static_argnames(call)
+    if _tail_name(call.func) == "partial" and call.args \
+            and is_wrapper_expr(call.args[0]):
+        return _static_argnames(call)
+    return None
+
+
+@dataclasses.dataclass
+class TracedInfo:
+    """Per-file result: traced function nodes + their static argnames."""
+    traced: Set[ast.AST]                      # FunctionDef nodes
+    static_names: Dict[ast.AST, Set[str]]     # node -> static_argnames
+
+    def statics_for(self, func: ast.AST) -> Set[str]:
+        return self.static_names.get(func, set())
+
+
+def _collect_functions(tree: ast.Module) -> List[ast.AST]:
+    return [n for n in ast.walk(tree) if isinstance(n, FuncNode)]
+
+
+def _local_bindings(func: ast.AST) -> Set[str]:
+    """Names bound locally anywhere inside ``func``: parameters,
+    assignment/loop/with/walrus/except targets, imports, nested defs."""
+    bound: Set[str] = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                     (ast.Store, ast.Del)):
+            bound.add(node.id)
+        elif isinstance(node, ast.arg):
+            bound.add(node.arg)
+        elif isinstance(node, FuncNode + (ast.ClassDef,)) and node is not func:
+            bound.add(node.name)
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            bound.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for a in node.names:
+                bound.add((a.asname or a.name).split(".")[0])
+    return bound
+
+
+def _called_names(func: ast.AST) -> Set[str]:
+    """Bare names this function's body calls or references.
+
+    References (not just calls) count: a function handed onwards
+    (``lax.scan(body, ...)``, ``jax.vmap(f)``) is traced without a
+    direct call expression.  Locally BOUND names are excluded — a local
+    ``report = x * 2`` shadows any same-named module function, and
+    letting it taint that function as traced produced false PL001
+    positives on host-only helpers.
+    """
+    loads: Set[str] = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            loads.add(node.id)
+    return loads - _local_bindings(func)
+
+
+def compute_traced(tree: ast.Module) -> TracedInfo:
+    funcs = _collect_functions(tree)
+    by_name: Dict[str, List[ast.AST]] = {}
+    for f in funcs:
+        by_name.setdefault(f.name, []).append(f)
+
+    traced: Set[ast.AST] = set()
+    static_names: Dict[ast.AST, Set[str]] = {}
+
+    # 1) decorated entry points
+    for f in funcs:
+        for dec in f.decorator_list:
+            statics = None
+            if is_wrapper_expr(dec):
+                statics = set()
+            elif isinstance(dec, ast.Call):
+                statics = _wrapper_call_info(dec)
+            if statics is not None:
+                traced.add(f)
+                static_names.setdefault(f, set()).update(statics)
+
+    # 2) call-site wrapping: jax.jit(f) / shard_map(f, ...) anywhere
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        statics = _wrapper_call_info(node)
+        if statics is None:
+            continue
+        for arg in node.args:
+            name = arg.id if isinstance(arg, ast.Name) else None
+            for f in by_name.get(name, []):
+                traced.add(f)
+                static_names.setdefault(f, set()).update(statics)
+
+    # 3) lexical nesting: functions defined inside a traced function
+    #    (iterate until stable; nesting can be several levels deep)
+    # 4) same-module call closure: names referenced from a traced body
+    changed = True
+    while changed:
+        changed = False
+        for f in list(traced):
+            inherited = static_names.get(f, set())
+            for inner in ast.walk(f):
+                if isinstance(inner, FuncNode) and inner is not f \
+                        and inner not in traced:
+                    traced.add(inner)
+                    static_names.setdefault(inner, set()).update(inherited)
+                    changed = True
+            for name in _called_names(f):
+                for g in by_name.get(name, []):
+                    if g not in traced:
+                        traced.add(g)
+                        changed = True
+    return TracedInfo(traced=traced, static_names=static_names)
+
+
+def owned_statements(func: ast.AST) -> List[ast.AST]:
+    """Nodes of ``func``'s body excluding nested function bodies.
+
+    Lets a rule visit each traced function exactly once even when its
+    closures are independently in the traced set.
+    """
+    out: List[ast.AST] = []
+    stack: List[ast.AST] = list(func.body)
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, FuncNode):
+                continue
+            stack.append(child)
+    return out
+
+
+def numpy_aliases(tree: ast.Module) -> Set[str]:
+    """Names bound to the numpy module by imports (usually {'np'})."""
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    aliases.add(a.asname or "numpy")
+    return aliases
+
+
+def jnp_aliases(tree: ast.Module) -> Set[str]:
+    """Names bound to jax.numpy (usually {'jnp'})."""
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.numpy" and a.asname:
+                    aliases.add(a.asname)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "numpy":
+                        aliases.add(a.asname or "numpy")
+    return aliases
+
+
+def lax_aliases(tree: ast.Module) -> Set[str]:
+    """Names bound to jax.lax (usually {'lax'}); 'jax' itself also gives
+    access via ``jax.lax`` attribute chains, handled by callers."""
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.lax" and a.asname:
+                    aliases.add(a.asname)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "lax":
+                        aliases.add(a.asname or "lax")
+    return aliases
+
+
+def root_name(expr: ast.AST) -> Optional[str]:
+    """Leftmost name of an attribute chain: ``jnp`` for ``jnp.isnan``."""
+    while isinstance(expr, ast.Attribute):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def attr_chain(expr: ast.AST) -> Tuple[str, ...]:
+    """('jax', 'lax', 'cond') for ``jax.lax.cond``; () when not a chain."""
+    parts: List[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return tuple(reversed(parts))
+    return ()

@@ -1,0 +1,10 @@
+"""Rule modules — importing this package registers every rule."""
+
+from tools.pertlint.rules import (  # noqa: F401
+    dtype_drift,
+    host_sync,
+    jit_in_loop,
+    partition_spec,
+    rng,
+    tracer_branch,
+)

@@ -1,0 +1,71 @@
+"""PL004: dtype-less jnp array constructors in numerics-critical paths.
+
+The enumeration kernel and model math are tuned for float32 (the Pallas
+kernels assume it; TPU matmul units want it; the f64-vs-f32 drift
+between x64-enabled hosts and TPU is a classic source of
+silently-different results).  ``jnp.zeros(shape)`` et al. pick their
+dtype from global config (``jax_enable_x64``) — an ambient global the
+kernel code must not depend on — so in ``ops/`` and ``models/`` every
+constructor states its dtype.
+
+Scope: files whose path contains an ``ops`` or ``models`` directory
+component (the rule is path-scoped; host-side pandas plumbing elsewhere
+may rely on numpy defaults freely).  ``dtype=`` may be a keyword or the
+constructor's positional dtype slot.  ``jnp.asarray`` is exempt — it is
+a *conversion*, preserving its input's dtype, not a fresh-dtype choice.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from typing import Iterable
+
+from tools.pertlint.core import Finding, Rule, register
+
+SCOPED_DIRS = {"ops", "models"}
+
+# constructor -> index of the positional dtype slot
+_CONSTRUCTORS = {"array": 1, "zeros": 1, "ones": 1, "empty": 1, "full": 2,
+                 "arange": None, "linspace": None}  # None: keyword-only check
+
+
+def _has_dtype(call: ast.Call, pos_slot) -> bool:
+    if any(kw.arg == "dtype" for kw in call.keywords):
+        return True
+    return pos_slot is not None and len(call.args) > pos_slot
+
+
+def in_scope(path: str) -> bool:
+    return bool(SCOPED_DIRS & set(pathlib.PurePosixPath(path).parts[:-1]))
+
+
+@register
+class DtypeDrift(Rule):
+    id = "PL004"
+    name = "dtype-drift"
+    severity = "error"
+    description = ("jnp.array/zeros/ones/full/... without an explicit "
+                   "dtype in ops/ or models/ inherits the ambient x64 "
+                   "config; state the dtype")
+
+    def check(self, ctx) -> Iterable[Finding]:
+        if not in_scope(ctx.path):
+            return
+        jnp_names = ctx.jnp_aliases
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not (isinstance(func, ast.Attribute)
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id in jnp_names
+                    and func.attr in _CONSTRUCTORS):
+                continue
+            if not _has_dtype(node, _CONSTRUCTORS[func.attr]):
+                yield self.finding(
+                    ctx, node,
+                    f"jnp.{func.attr} without an explicit dtype in a "
+                    f"numerics-critical path; the result dtype follows the "
+                    f"ambient jax_enable_x64 config — pass dtype=jnp.float32 "
+                    f"(or the intended dtype)")

@@ -1,0 +1,93 @@
+"""PL001: host synchronisation inside jit-traced code.
+
+``float()`` / ``int()`` / ``bool()`` / ``.item()`` / ``np.asarray`` /
+``np.array`` / ``jax.device_get`` applied to a traced value forces a
+device->host transfer and a blocking sync — inside the compiled training
+loop it either fails at trace time (ConcretizationTypeError) or, on the
+paths where jax tolerates it, silently serialises the hot loop on the
+host round-trip (BENCH lineage: the whole point of the one-dispatch
+``lax.while_loop`` driver in infer/svi.py is that no such sync exists).
+
+Exemptions that keep the rule precise:
+
+* literal arguments (``float(1e-6)``) — no tracer involved;
+* names listed in the jit decoration's ``static_argnames`` — Python
+  values by construction;
+* ``len(...)`` / ``.shape`` / ``.ndim`` / ``.size`` / ``.dtype``
+  arguments — static metadata, not traced data.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.pertlint import jitgraph
+from tools.pertlint.core import Finding, Rule, register
+
+_CASTS = {"float", "int", "bool", "complex"}
+_NUMPY_SYNCS = {"asarray", "array"}
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype"}
+
+
+def _is_static_expr(expr: ast.AST, statics) -> bool:
+    if isinstance(expr, ast.Constant):
+        return True
+    if isinstance(expr, ast.Name) and expr.id in statics:
+        return True
+    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name) \
+            and expr.func.id == "len":
+        return True
+    # x.shape, x.shape[0], x.dtype, ...
+    node = expr
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+        return True
+    return False
+
+
+@register
+class HostSyncInJit(Rule):
+    id = "PL001"
+    name = "host-sync-in-jit"
+    severity = "error"
+    description = ("float()/int()/bool()/.item()/np.asarray on a traced "
+                   "value inside jit/shard_map-reachable code forces a "
+                   "host sync")
+
+    def check(self, ctx) -> Iterable[Finding]:
+        traced = ctx.traced
+        np_names = ctx.numpy_aliases
+        for func in traced.traced:
+            statics = traced.statics_for(func)
+            for node in jitgraph.owned_statements(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                yield from self._check_call(ctx, node, statics, np_names)
+
+    def _check_call(self, ctx, call: ast.Call, statics, np_names):
+        func = call.func
+        if isinstance(func, ast.Name) and func.id in _CASTS:
+            if call.args and not _is_static_expr(call.args[0], statics):
+                yield self.finding(
+                    ctx, call,
+                    f"{func.id}() on a (potentially traced) value inside "
+                    f"jit-reachable code forces a host sync; compute with "
+                    f"jnp/lax ops or mark the argument static")
+        elif isinstance(func, ast.Attribute):
+            if func.attr == "item" and not call.args:
+                yield self.finding(
+                    ctx, call,
+                    ".item() inside jit-reachable code forces a host sync")
+            elif func.attr in _NUMPY_SYNCS \
+                    and jitgraph.root_name(func) in np_names:
+                yield self.finding(
+                    ctx, call,
+                    f"np.{func.attr}() inside jit-reachable code pulls the "
+                    f"value to host; use jnp.{func.attr} (stays on device)")
+            elif func.attr == "device_get":
+                yield self.finding(
+                    ctx, call,
+                    "jax.device_get inside jit-reachable code forces a "
+                    "host sync")

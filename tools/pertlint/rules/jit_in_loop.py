@@ -1,0 +1,58 @@
+"""PL006: jax.jit constructed inside a loop — a recompilation hazard.
+
+``jax.jit`` returns a *new* wrapped callable with its own compilation
+cache; constructing one per loop iteration (or per call of a hot
+function) recompiles the target every time, turning a microsecond
+dispatch into a seconds-long XLA compile.  The fix is to hoist the
+``jit`` (module level, or ``functools.partial`` applied once) — the
+package's own drivers compile exactly once per fit (infer/svi.py) and
+the benchmark deliberately scans all iterations inside one program
+(bench.py) for the same reason.
+
+Flagged: ``jit(...)`` / ``jax.jit(...)`` / ``partial(jax.jit, ...)``
+call expressions lexically inside a ``for``/``while`` body (including
+comprehensions).  Decorators are statements, not loop bodies, and never
+match.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.pertlint import jitgraph
+from tools.pertlint.core import Finding, Rule, register
+
+_LOOPS = (ast.For, ast.AsyncFor, ast.While, ast.ListComp, ast.SetComp,
+          ast.DictComp, ast.GeneratorExp)
+
+
+@register
+class JitInLoop(Rule):
+    id = "PL006"
+    name = "jit-in-loop"
+    severity = "error"
+    description = ("jax.jit / partial(jax.jit, ...) constructed inside a "
+                   "loop recompiles per iteration; hoist it")
+
+    def check(self, ctx) -> Iterable[Finding]:
+        parents = ctx.parents
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            is_jit = jitgraph.is_wrapper_expr(node.func) or (
+                jitgraph._tail_name(node.func) == "partial"
+                and node.args and jitgraph.is_wrapper_expr(node.args[0]))
+            if not is_jit:
+                continue
+            # walk ancestors; a decorator position never sits under a loop
+            cur = node
+            while cur in parents:
+                cur = parents[cur]
+                if isinstance(cur, _LOOPS):
+                    yield self.finding(
+                        ctx, node,
+                        "jax.jit constructed inside a loop builds a fresh "
+                        "compilation cache every iteration (recompiles "
+                        "each time); hoist the jit outside the loop")
+                    break

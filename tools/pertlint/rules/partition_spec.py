@@ -1,0 +1,63 @@
+"""PL003: PartitionSpec constructed outside layout.py.
+
+``layout.py`` is the single owner of the tensor-layout contract — every
+``jax.sharding.PartitionSpec`` in the package is built there so the mesh
+placement (``parallel/mesh.py``) and the ``shard_map`` call sites
+(``models/pert.py``) can never disagree about which axis is which (the
+round-4 state-major migration broke five modules at once precisely
+because this convention was duplicated).  Constructing a raw
+PartitionSpec anywhere else reintroduces that failure mode.
+
+Detection: any call to a name bound (by import) to
+``jax.sharding.PartitionSpec`` — including ``as P`` renames — or a
+``jax.sharding.PartitionSpec(...)`` / ``sharding.PartitionSpec(...)``
+attribute call, in any file whose name is not in the allowlist.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from typing import Iterable, Set
+
+from tools.pertlint.core import Finding, Rule, register
+
+ALLOWED_FILENAMES = {"layout.py"}
+
+
+def _spec_aliases(tree: ast.Module) -> Set[str]:
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module \
+                and node.module.endswith("sharding"):
+            for a in node.names:
+                if a.name == "PartitionSpec":
+                    aliases.add(a.asname or a.name)
+    return aliases
+
+
+@register
+class RawPartitionSpec(Rule):
+    id = "PL003"
+    name = "raw-partitionspec"
+    severity = "error"
+    description = ("PartitionSpec constructed outside layout.py, the "
+                   "single owner of the sharding contract")
+
+    def check(self, ctx) -> Iterable[Finding]:
+        if pathlib.PurePosixPath(ctx.path).name in ALLOWED_FILENAMES:
+            return
+        aliases = _spec_aliases(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            direct = isinstance(func, ast.Name) and func.id in aliases
+            dotted = isinstance(func, ast.Attribute) \
+                and func.attr == "PartitionSpec"
+            if direct or dotted:
+                yield self.finding(
+                    ctx, node,
+                    "raw PartitionSpec constructed outside layout.py; add "
+                    "a spec builder to layout.py (single source of truth "
+                    "for the sharding contract) and call that instead")

@@ -1,0 +1,55 @@
+"""PL005: legacy global-state numpy RNG in library code.
+
+``np.random.rand`` / ``np.random.seed`` / ... mutate numpy's hidden
+global generator: results depend on call order across the whole process,
+two pipeline stages can perturb each other, and no amount of per-stage
+seeding makes a run reproducible once library code touches the global
+stream.  Library code must thread an explicit ``np.random.Generator``
+(``np.random.default_rng(seed)``) — the package's own convention
+(``api.py`` seeds one at construction) — or use ``jax.random`` keys.
+
+Constructor calls (``default_rng``, ``Generator``, ``SeedSequence`` and
+the bit generators) are exempt: they *create* explicit streams.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.pertlint.core import Finding, Rule, register
+
+_EXPLICIT_CONSTRUCTORS = {"default_rng", "Generator", "SeedSequence",
+                          "RandomState",  # legacy but still an instance
+                          "PCG64", "PCG64DXSM", "Philox", "SFC64", "MT19937"}
+
+
+@register
+class UnseededRng(Rule):
+    id = "PL005"
+    name = "unseeded-rng"
+    severity = "error"
+    description = ("numpy.random module-level call (global hidden RNG "
+                   "state) in library code; thread a "
+                   "np.random.default_rng(seed) Generator instead")
+
+    def check(self, ctx) -> Iterable[Finding]:
+        np_names = ctx.numpy_aliases
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            # np.random.<fn>(...) — an Attribute on Attribute('random')
+            if not (isinstance(func, ast.Attribute)
+                    and isinstance(func.value, ast.Attribute)
+                    and func.value.attr == "random"
+                    and isinstance(func.value.value, ast.Name)
+                    and func.value.value.id in np_names):
+                continue
+            if func.attr in _EXPLICIT_CONSTRUCTORS:
+                continue
+            yield self.finding(
+                ctx, node,
+                f"np.random.{func.attr} uses numpy's global RNG state; "
+                f"thread an explicit np.random.default_rng(seed) Generator "
+                f"through instead")

@@ -1,0 +1,68 @@
+"""PL002: Python control flow on traced values.
+
+A Python ``if``/``while`` inside traced code evaluates its test eagerly
+at trace time; when the test depends on a traced value this raises
+``ConcretizationTypeError`` — or worse, silently bakes one branch into
+the compiled program when the value happens to be concrete during
+tracing but data-dependent at run time.  ``lax.cond`` /
+``lax.while_loop`` / ``jnp.where`` are the device-side forms.
+
+Detection is conservative to stay precise: a test is flagged when its
+expression *computes with jax* — it contains a call rooted at the
+module's ``jnp``/``lax`` aliases or a ``jax.*`` attribute chain
+(``if jnp.isnan(loss):``, ``while lax.lt(i, n):``, ``if x.any():`` where
+``x`` came from jnp stays out of reach of an AST pass and is left to
+runtime).  Static configuration branches (``if spec.sparse_etas:``,
+``if mask is None:``) never match, which is exactly right — those are
+legal and idiomatic under jit.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.pertlint import jitgraph
+from tools.pertlint.core import Finding, Rule, register
+
+
+def _jax_call_in(expr: ast.AST, jnp_names, lax_names) -> bool:
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = jitgraph.attr_chain(node.func)
+        if not chain:
+            continue
+        root = chain[0]
+        if root in jnp_names or root in lax_names:
+            return True
+        if root == "jax":  # jax.lax.*, jax.numpy.*
+            return True
+    return False
+
+
+@register
+class TracerBranch(Rule):
+    id = "PL002"
+    name = "tracer-branch"
+    severity = "error"
+    description = ("Python if/while on a jax-computed value inside traced "
+                   "code; use lax.cond/lax.while_loop/jnp.where")
+
+    def check(self, ctx) -> Iterable[Finding]:
+        jnp_names = ctx.jnp_aliases
+        lax_names = ctx.lax_aliases
+        for func in ctx.traced.traced:
+            for node in jitgraph.owned_statements(func):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                if _jax_call_in(node.test, jnp_names, lax_names):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    repl = ("lax.cond / jnp.where"
+                            if isinstance(node, ast.If)
+                            else "lax.while_loop / lax.fori_loop")
+                    yield self.finding(
+                        ctx, node,
+                        f"Python `{kind}` on a jax-computed value inside "
+                        f"jit-reachable code branches at trace time; "
+                        f"use {repl}")

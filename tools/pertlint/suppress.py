@@ -1,0 +1,74 @@
+"""Inline suppression comments.
+
+``# pertlint: disable=PL001`` (or ``disable=PL001,PL004``) on a line
+suppresses those rules for findings anchored to that line.  ``disable``
+with no ``=RULE`` list suppresses every rule on the line.  A whole-file
+opt-out is ``# pertlint: disable-file=PL003`` on any line (intended for
+the top of the module, next to the reason).
+
+Comments are found with :mod:`tokenize` rather than a substring scan so
+a string literal containing the marker text can never suppress anything.
+
+Malformed markers fail CLOSED: a typo'd keyword (``disable-files=``) or
+a rule list with no valid ``PLnnn`` ids suppresses nothing — a silent
+widen-to-everything here would turn a typo into a disabled CI gate.
+Rule ids are case-normalised (``pl005`` works).
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+from typing import Dict, Set, Tuple
+
+# the kind must be followed by '=', whitespace or end-of-comment, so
+# 'disable-files=' / 'disabled' don't half-match as a bare 'disable'
+_MARKER = re.compile(
+    r"#\s*pertlint:\s*(?P<kind>disable(?:-file)?)(?=[\s=]|$)"
+    r"(?:\s*=\s*(?P<rules>[A-Za-z0-9_,\s]+))?")
+_RULE_ID = re.compile(r"PL\d{3}$")
+
+ALL = "*"
+
+
+def parse_suppressions(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """-> (line -> suppressed rule ids, file-wide suppressed rule ids).
+
+    Rule-id sets may contain :data:`ALL`, meaning every rule.
+    """
+    per_line: Dict[int, Set[str]] = {}
+    file_wide: Set[str] = set()
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        comments = [(tok.start[0], tok.string) for tok in tokens
+                    if tok.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return per_line, file_wide
+
+    for line, text in comments:
+        m = _MARKER.search(text)
+        if not m:
+            continue
+        if m.group("rules") is not None:
+            rules = {r.strip().upper()
+                     for r in m.group("rules").split(",") if r.strip()}
+            rules = {r for r in rules if _RULE_ID.fullmatch(r)}
+            if not rules:
+                continue        # no valid rule id at all: fail closed
+        else:
+            rules = {ALL}       # bare 'disable' (no '='): everything
+        if m.group("kind") == "disable-file":
+            file_wide |= rules
+        else:
+            per_line.setdefault(line, set()).update(rules)
+    return per_line, file_wide
+
+
+def is_suppressed(rule_id: str, line: int,
+                  per_line: Dict[int, Set[str]],
+                  file_wide: Set[str]) -> bool:
+    if ALL in file_wide or rule_id in file_wide:
+        return True
+    rules = per_line.get(line)
+    return bool(rules) and (ALL in rules or rule_id in rules)

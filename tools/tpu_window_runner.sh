@@ -75,10 +75,13 @@ run_one() {  # run_one <name> <tpu_field> <timeout_s> <cmd...>
 }
 
 battery() {  # returns 0 only if every step it attempted succeeded
+    # --budget full: keep the production-shaped sizes on TPU (bench.py
+    # defaults to --budget fast so the bare harness invocation can't
+    # time out like BENCH_r05's rc=124)
     run_one BENCH_r05_tpu_300iter device_platform 900 \
-        python bench.py --platform tpu --iters 300 --skip-baseline || return 1
+        python bench.py --platform tpu --budget full --iters 300 --skip-baseline || return 1
     run_one BENCH_r05_tpu_10k device_platform 1200 \
-        python bench.py --platform tpu --cells 10000 --iters 50 --skip-baseline || return 1
+        python bench.py --platform tpu --budget full --cells 10000 --iters 50 --skip-baseline || return 1
     run_one FULL_PIPELINE_r05_rescue_tpu platform 1500 \
         python tools/full_pipeline_bench.py --run-step3 --mirror-rescue \
             --out artifacts/FULL_PIPELINE_r05_rescue_tpu.json || return 1
