@@ -23,11 +23,67 @@ import argparse
 import functools
 import json
 import os
+import pathlib
 import subprocess
 import sys
 import time
 
 import numpy as np
+
+# Committed torch-twin baseline cache.  The twin is deterministic for a
+# fixed (shape, config, seed) — identical tensors, identical Adam — so
+# re-measuring it on every run only adds noise and wall (at the full
+# 1000x5451 shape ~20 min on a contended CPU: the reason BENCH_r05.json
+# recorded rc=124 instead of a number).  The committed artifact keys
+# per-iteration seconds by problem shape; lookups hit for the budget
+# presets and any shape that has been cached with --write-baseline-cache.
+BASELINE_CACHE_PATH = (pathlib.Path(__file__).resolve().parent
+                       / "artifacts" / "BENCH_BASELINE_torch_twin.json")
+
+
+def _baseline_key(args):
+    return {"cells": args.cells, "loci": args.loci, "P": args.P,
+            "K": args.K, "seed": 0}
+
+
+def load_cached_baseline(args, path=None):
+    """Cached torch-twin entry matching this problem shape, or None."""
+    path = pathlib.Path(path or BASELINE_CACHE_PATH)
+    try:
+        data = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+    key = _baseline_key(args)
+    for entry in data.get("entries", []):
+        if all(entry.get(k) == v for k, v in key.items()):
+            return entry
+    return None
+
+
+def write_baseline_cache(args, sec_per_iter, final_loss, path=None):
+    """Insert/replace this shape's entry in the committed baseline cache."""
+    path = pathlib.Path(path or BASELINE_CACHE_PATH)
+    try:
+        data = json.loads(path.read_text())
+    except (OSError, ValueError):
+        data = {"note": "torch-CPU twin of the step-2 objective "
+                        "(bench_torch_cpu), measured once per problem "
+                        "shape and reused by bench.py so the CPU-fallback "
+                        "path never re-pays the ~20-min measurement; "
+                        "refresh with --write-baseline-cache",
+                "entries": []}
+    key = _baseline_key(args)
+    data["entries"] = [e for e in data.get("entries", [])
+                       if not all(e.get(k) == v for k, v in key.items())]
+    entry = dict(key, baseline_iters=args.baseline_iters,
+                 sec_per_iter=round(sec_per_iter, 4),
+                 final_loss=final_loss,
+                 measured_at=time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                           time.gmtime()))
+    data["entries"].append(entry)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(data, indent=1) + "\n")
+    return entry
 
 
 def probe_backend(timeout=150, retries=2):
@@ -283,6 +339,14 @@ def _parse_args(argv=None):
     # the per-iter mean under a few percent (torch CPU steady state)
     ap.add_argument("--baseline-iters", type=int, default=None)
     ap.add_argument("--skip-baseline", action="store_true")
+    ap.add_argument("--remeasure-baseline", action="store_true",
+                    help="ignore the committed torch-twin cache "
+                         "(artifacts/BENCH_BASELINE_torch_twin.json) and "
+                         "measure the baseline fresh at full iters")
+    ap.add_argument("--write-baseline-cache", action="store_true",
+                    help="measure the torch twin at this shape (full "
+                         "--baseline-iters, no jax run) and insert it "
+                         "into the committed cache artifact, then exit")
     ap.add_argument("--enum-impl", default="auto",
                     choices=["auto", "xla", "pallas", "pallas_sparse",
                              "pallas_interpret"])
@@ -353,12 +417,32 @@ def _run(args, platform, probe_attempts=None):
         raise RuntimeError(f"all enum impls failed: {errors}")
     cells_per_sec = args.cells / jax_per_iter
 
+    baseline_source = None
+    baseline_iters_used = 0  # iterations actually MEASURED in this run
     if args.skip_baseline:
         vs = None  # JSON null — a bare NaN breaks strict (RFC 8259) parsers
         cpu_per_iter = None
     else:
-        cpu_per_iter, _ = bench_torch_cpu(args.cells, args.loci, args.P,
-                                          args.K, args.baseline_iters)
+        cached = (None if args.remeasure_baseline
+                  else load_cached_baseline(args))
+        if cached is not None:
+            cpu_per_iter = float(cached["sec_per_iter"])
+            baseline_source = (f"cached_artifact "
+                               f"({cached.get('baseline_iters')} iters, "
+                               f"{cached.get('measured_at')})")
+        else:
+            iters_b = args.baseline_iters
+            if on_cpu and not args.remeasure_baseline:
+                # no cache hit on the fallback path: bound the twin so the
+                # worst-case (dead tunnel, uncached shape) still lands its
+                # JSON line well inside the driver window; the honest
+                # full-depth measurement stays available via
+                # --remeasure-baseline or --write-baseline-cache
+                iters_b = min(iters_b, 3)
+            cpu_per_iter, _ = bench_torch_cpu(args.cells, args.loci, args.P,
+                                              args.K, iters_b)
+            baseline_source = "measured"
+            baseline_iters_used = iters_b
         vs = cpu_per_iter / jax_per_iter
 
     # measured, not the forced/probed label: --platform tpu with a dead
@@ -388,7 +472,10 @@ def _run(args, platform, probe_attempts=None):
         "candidates_sec_per_iter": candidate_secs,
         "baseline_sec_per_iter": (None if cpu_per_iter is None
                                   else round(cpu_per_iter, 4)),
-        "baseline_iters": (0 if args.skip_baseline else args.baseline_iters),
+        "baseline_source": baseline_source,
+        # iterations measured IN THIS RUN (0 when cached/skipped); the
+        # cache entry's own measurement depth rides in baseline_source
+        "baseline_iters": baseline_iters_used,
         "baseline_note": "vs_baseline divides by an in-image torch-CPU "
                          "twin of the reference's step-2 objective "
                          "(pyro-ppl is not installable here), not a "
@@ -403,6 +490,15 @@ def _run(args, platform, probe_attempts=None):
 
 def main():
     args = _parse_args()
+
+    if args.write_baseline_cache:
+        sec, loss = bench_torch_cpu(args.cells, args.loci, args.P, args.K,
+                                    args.baseline_iters)
+        entry = write_baseline_cache(args, sec, loss)
+        print(json.dumps({"metric": "torch_twin_baseline_cached",
+                          "entry": entry,
+                          "path": str(BASELINE_CACHE_PATH)}))
+        return
 
     platform = args.platform
     probe_attempts = None
@@ -453,6 +549,8 @@ def main():
                 "xla" if args.enum_impl == "auto" else args.enum_impl]
         if args.skip_baseline:
             argv.append("--skip-baseline")
+        if args.remeasure_baseline:
+            argv.append("--remeasure-baseline")
         out = subprocess.run(argv, env=env)
         sys.exit(out.returncode)
 

@@ -72,20 +72,22 @@ def make_input_frames(num_loci=150, cells_per_clone=20, seed=7):
 
 
 def simulate_pert_frames(df_s, df_g, num_reads=50_000, lamb=0.75, a=10.0,
-                         seed=3):
+                         seed=3, tau_range=None):
     """Simulate reads and alias them into the PERT input convention.
 
     The tutorial (and tools/accuracy_sweep.py, which imports this) feeds
     the simulator's normalised read counts as ``reads`` and the true
     somatic CN as both ``state`` and ``copy`` — one place so the
     convention cannot drift between the walkthrough and the sweep.
+    ``tau_range`` restricts the true S-phase times (late-S-heavy cohorts
+    exercise the mirror-rescue path; see pert_simulator).
     """
     from scdna_replication_tools_tpu.models.simulator import pert_simulator
 
     sim_s, sim_g = pert_simulator(
         df_s, df_g, num_reads=num_reads, rt_cols=["rt_A", "rt_B"],
         clones=["A", "B"], lamb=lamb, betas=np.array([0.5, 0.0]), a=a,
-        seed=seed)
+        seed=seed, tau_range=tau_range)
     for d in (sim_s, sim_g):
         d["reads"] = d["true_reads_norm"]
         d["state"] = d["true_somatic_cn"]

@@ -34,7 +34,9 @@ class scRT:
 
     Mirrors ``infer_scRT.scRT`` (reference: infer_scRT.py:25-105) with the
     same keyword surface; TPU-execution extras: ``backend``, ``num_shards``,
-    ``cell_chunk``, ``checkpoint_dir``; ``clustering_method`` selects the
+    ``cell_chunk``, ``checkpoint_dir``, ``compile_cache_dir`` (persistent
+    XLA compilation cache — 'auto' = repo-local, None disables);
+    ``clustering_method`` selects the
     G1 clone-discovery algorithm when ``clone_col=None`` (``'kmeans'``
     as the reference hardwires, or ``'umap_hdbscan'`` — its optional
     cncluster path), with ``clustering_kwargs`` forwarded to it.
@@ -56,7 +58,8 @@ class scRT:
                  run_step3=True, backend='jax', num_shards=1,
                  loci_shards=1, cell_chunk=None, checkpoint_dir=None,
                  enum_impl='auto', cn_hmm_self_prob=None,
-                 rho_from_rt_prior=False, mirror_rescue=False,
+                 rho_from_rt_prior=False, mirror_rescue=True,
+                 compile_cache_dir='auto',
                  clustering_method='kmeans', clustering_kwargs=None):
         self.cn_s = cn_s
         self.cn_g1 = cn_g1
@@ -90,12 +93,16 @@ class scRT:
             cn_hmm_self_prob=cn_hmm_self_prob,
             rho_from_rt_prior=rho_from_rt_prior,
             mirror_rescue=mirror_rescue,
+            compile_cache_dir=compile_cache_dir,
         )
 
         self.clone_profiles = None
         self.bulk_cn = None
         self.manhattan_df = None
         self.mirror_rescue_stats = None  # set by infer(level='pert')
+        self.phase_report = None         # set by infer(level='pert'):
+        # {phase: seconds} wall-clock ledger of the whole run (clone prep,
+        # load, per-step build/h2d/trace/compile/fit, decode, packaging)
 
     # -- dispatch (reference: infer_scRT.py:108-124) ----------------------
 
@@ -145,29 +152,40 @@ class scRT:
     # -- PERT (reference: infer_scRT.py:127-168) --------------------------
 
     def infer_pert_model(self):
+        from scdna_replication_tools_tpu.utils.profiling import PhaseTimer
+
         c = self.cols
-        self._ensure_clones(c.assign_col)
+        timer = PhaseTimer()
+        with timer.phase("clone_prep"):
+            self._ensure_clones(c.assign_col)
 
-        cols = (self.cols if self.clone_col == c.clone_col else
-                ColumnConfig(**{**self.cols.__dict__, 'clone_col': self.clone_col}))
-        s_data, g1_data = build_pert_inputs(self.cn_s, self.cn_g1, cols)
+            cols = (self.cols if self.clone_col == c.clone_col else
+                    ColumnConfig(**{**self.cols.__dict__,
+                                    'clone_col': self.clone_col}))
 
-        # dense clone indices aligned to the data cell order
-        clone_ids = sorted(self.cn_g1[self.clone_col].astype(str).unique())
-        clone_map = {cid: i for i, cid in enumerate(clone_ids)}
+        with timer.phase("load"):
+            s_data, g1_data = build_pert_inputs(self.cn_s, self.cn_g1, cols)
 
-        def _clone_idx(cn, cell_ids):
-            per_cell = cn[[c.cell_col, self.clone_col]] \
-                .drop_duplicates(c.cell_col).set_index(c.cell_col)[self.clone_col]
-            return np.array([clone_map[str(per_cell[cid])]
-                             for cid in cell_ids], np.int32)
+            # dense clone indices aligned to the data cell order
+            clone_ids = sorted(self.cn_g1[self.clone_col].astype(str)
+                               .unique())
+            clone_map = {cid: i for i, cid in enumerate(clone_ids)}
 
-        inference = PertInference(
-            s_data, g1_data, self.config,
-            clone_idx_s=_clone_idx(self.cn_s, s_data.cell_ids),
-            clone_idx_g1=_clone_idx(self.cn_g1, g1_data.cell_ids),
-            num_clones=len(clone_ids),
-        )
+            def _clone_idx(cn, cell_ids):
+                per_cell = cn[[c.cell_col, self.clone_col]] \
+                    .drop_duplicates(c.cell_col) \
+                    .set_index(c.cell_col)[self.clone_col]
+                return np.array([clone_map[str(per_cell[cid])]
+                                 for cid in cell_ids], np.int32)
+
+            inference = PertInference(
+                s_data, g1_data, self.config,
+                clone_idx_s=_clone_idx(self.cn_s, s_data.cell_ids),
+                clone_idx_g1=_clone_idx(self.cn_g1, g1_data.cell_ids),
+                num_clones=len(clone_ids),
+            )
+        # the runner accumulates its per-step phases into the same ledger
+        inference.phases = timer
         step1, step2, step3 = inference.run()
         # surfaced for callers/tools (None unless mirror_rescue ran)
         self.mirror_rescue_stats = inference.mirror_rescue_stats
@@ -180,16 +198,19 @@ class scRT:
             self.cn_s, inference._step2_data, step2, lamb,
             step1.fit.losses, step2.fit.losses, cols,
             hmm_self_prob=self.config.cn_hmm_self_prob,
-            mirror_rescue_stats=inference.mirror_rescue_stats)
+            mirror_rescue_stats=inference.mirror_rescue_stats,
+            timer=timer, phase_prefix="package_s")
 
         if step3 is not None:
             cn_g1_out, supp_g1_out = package_step_output(
                 self.cn_g1, inference._step3_data, step3, lamb,
                 step1.fit.losses, step3.fit.losses, cols,
-                hmm_self_prob=self.config.cn_hmm_self_prob)
+                hmm_self_prob=self.config.cn_hmm_self_prob,
+                timer=timer, phase_prefix="package_g1")
         else:
             cn_g1_out, supp_g1_out = None, None
 
+        self.phase_report = timer.report()
         return cn_s_out, supp_s_out, cn_g1_out, supp_g1_out
 
     # -- deterministic levels (implemented in pipeline/, wired in api) ----

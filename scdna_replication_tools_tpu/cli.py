@@ -40,9 +40,18 @@ def infer_scrt_main(argv=None):
                    help="clone-discovery algorithm used when "
                         "--clone-col none")
     p.add_argument("--num-shards", type=int, default=1)
-    p.add_argument("--mirror-rescue", action="store_true",
+    from argparse import BooleanOptionalAction
+    p.add_argument("--mirror-rescue", action=BooleanOptionalAction,
+                   default=True,
                    help="post-step-2 mirror-basin rescue for boundary-tau "
-                        "cells (beyond-reference; PertConfig.mirror_rescue)")
+                        "cells (beyond-reference; default ON — "
+                        "--no-mirror-rescue restores the reference-faithful "
+                        "no-rescue trajectory; PertConfig.mirror_rescue)")
+    p.add_argument("--compile-cache", default="auto",
+                   help="persistent XLA compilation cache directory: "
+                        "'auto' (default, repo-local .jax_cache), a path, "
+                        "or 'none' to disable "
+                        "(PertConfig.compile_cache_dir)")
     args = p.parse_args(argv)
 
     from scdna_replication_tools_tpu.api import scRT
@@ -54,7 +63,8 @@ def infer_scrt_main(argv=None):
                 cn_prior_method=args.cn_prior_method,
                 max_iter=args.max_iter, num_shards=args.num_shards,
                 clustering_method=args.clustering_method,
-                mirror_rescue=args.mirror_rescue)
+                mirror_rescue=args.mirror_rescue,
+                compile_cache_dir=args.compile_cache)
     out_df, supp_df, _, _ = scrt.infer(level=args.level)
 
     out_df.to_csv(args.output, sep="\t", index=False)

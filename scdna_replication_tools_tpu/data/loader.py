@@ -321,6 +321,53 @@ def build_pert_inputs(
     return _make(s_reads, s_states, libs_s), _make(g1_reads, g1_states, libs_g1)
 
 
+def attach_dense_columns(
+    cn_long: pd.DataFrame,
+    cell_ids,
+    loci: pd.MultiIndex,
+    cols: ColumnConfig = ColumnConfig(),
+    per_bin: Optional[dict] = None,
+    per_cell: Optional[dict] = None,
+    per_locus: Optional[dict] = None,
+) -> pd.DataFrame:
+    """Array-native unpivot: attach dense model outputs to a long frame.
+
+    The melt-then-merge packaging path the reference uses
+    (pert_model.py:480-538) builds a full loci x cells DataFrame per
+    output column, melts it to long form and inner-merges — several
+    million-row hash joins per packaged step.  This helper produces the
+    identical result with one factorisation and O(rows) gathers: each
+    long row is mapped to its (cell, locus) dense codes, rows whose cell
+    or locus is absent from the dense axes are dropped (the inner-join
+    semantics of the merge path, left order preserved), and every output
+    column is a single NumPy fancy-index into the dense matrix/vector.
+
+    ``per_bin`` maps column name -> (cells, loci) array; ``per_cell`` ->
+    (cells,) array; ``per_locus`` -> (loci,) array, all aligned to
+    ``cell_ids`` / ``loci``.
+    """
+    cell_codes = pd.Categorical(cn_long[cols.cell_col],
+                                categories=cell_ids).codes
+    loci_key = pd.MultiIndex.from_arrays(
+        [loci.get_level_values(0).astype(str), loci.get_level_values(1)])
+    row_key = pd.MultiIndex.from_arrays(
+        [cn_long[cols.chr_col].astype(str),
+         cn_long[cols.start_col].to_numpy()])
+    locus_codes = loci_key.get_indexer(row_key)
+
+    keep = (cell_codes >= 0) & (locus_codes >= 0)
+    out = cn_long[keep].reset_index(drop=True)
+    cc = np.asarray(cell_codes)[keep]
+    lc = locus_codes[keep]
+    for name, mat in (per_bin or {}).items():
+        out[name] = np.asarray(mat)[cc, lc]
+    for name, vec in (per_cell or {}).items():
+        out[name] = np.asarray(vec)[cc]
+    for name, vec in (per_locus or {}).items():
+        out[name] = np.asarray(vec)[lc]
+    return out
+
+
 def pad_cells(data: PertData, multiple: int) -> PertData:
     """Pad the cells axis to a multiple of ``multiple`` with masked cells.
 

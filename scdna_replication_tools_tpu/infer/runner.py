@@ -32,6 +32,7 @@ import pandas as pd
 from scdna_replication_tools_tpu.config import ColumnConfig, PertConfig
 from scdna_replication_tools_tpu.data.loader import (
     PertData,
+    attach_dense_columns,
     pad_cells,
     pad_loci,
 )
@@ -106,6 +107,26 @@ class StepOutput:
     wall_time: float
 
 
+@dataclasses.dataclass(frozen=True)
+class _PertLossFn:
+    """Value-hashable loss callable for the program cache.
+
+    Two fits whose (spec, mesh) are equal — and whose arguments share
+    shapes/dtypes/shardings — are the SAME XLA program; closing over
+    spec/mesh in a fresh lambda per step hid that equality from every
+    cache layer (jax.jit keys on callable identity), so each step paid
+    its own trace_to_jaxpr + compile.  A frozen dataclass compares and
+    hashes by value, which lets ``infer.svi``'s AOT program cache (and,
+    transitively, the persistent compilation cache) dedupe the builds.
+    """
+
+    spec: PertModelSpec
+    mesh: object = None  # jax.sharding.Mesh is hashable
+
+    def __call__(self, params, fixed, batch):
+        return pert_loss(self.spec, params, fixed, batch, mesh=self.mesh)
+
+
 class PertInference:
     """Orchestrates the three SVI steps on dense inputs.
 
@@ -131,6 +152,15 @@ class PertInference:
         self.num_clones = num_clones
         self.L = s_data.num_libraries
         self.mirror_rescue_stats = None  # filled by _mirror_rescue
+        # end-to-end phase ledger: every stage of steps 1-3 (build, h2d,
+        # trace, compile, fit, decode, packaging...) accumulates here so
+        # callers (api.scRT, tools/full_pipeline_bench) can report where
+        # the wall-clock actually went
+        self.phases = profiling.PhaseTimer()
+        # persistent XLA compilation cache (no-op when already configured
+        # or disabled): repeated runs skip the per-step-program compiles
+        self.compile_cache_dir = profiling.enable_persistent_compile_cache(
+            config.compile_cache_dir)
         if config.rho_from_rt_prior and s_data.rt_prior is None:
             # fail fast: surfacing this inside run_step2 would waste the
             # whole step-1 fit first
@@ -323,14 +353,20 @@ class PertInference:
                 losses_prefix = np.asarray(losses)[:num_iters]
 
         if params0 is None:
-            params0 = init_params(spec, batch, fixed, t_init=t_init)
+            with self.phases.phase(f"{step_name}/init"):
+                params0 = init_params(spec, batch, fixed, t_init=t_init)
         self._warn_if_enum_tensor_huge(spec, batch)
-        batch, params0 = self._maybe_shard(batch, params0)
+        with self.phases.phase(f"{step_name}/h2d"):
+            # resharding + an explicit barrier so the async host->device
+            # transfers jnp.asarray enqueued are accounted here, not
+            # silently folded into the fit phase
+            batch, params0 = self._maybe_shard(batch, params0)
+            batch, params0, fixed = jax.block_until_ready(
+                (batch, params0, fixed))
         mesh = self._mesh if spec.enum_impl in ("pallas",
                                                 "pallas_interpret") else None
 
-        def loss_fn(params, fixed, batch):
-            return pert_loss(spec, params, fixed, batch, mesh=mesh)
+        loss_fn = _PertLossFn(spec=spec, mesh=mesh)
 
         t0 = time.perf_counter()
         with profiling.trace(cfg.profile_dir):
@@ -342,80 +378,86 @@ class PertInference:
                           opt_state0=opt_state0,
                           losses_prefix=losses_prefix)
         wall = time.perf_counter() - t0
+        for key in ("trace", "compile", "fit"):
+            self.phases.add(f"{step_name}/{key}", fit.timings.get(key, 0.0))
         profiling.log_step_summary(step_name, fit, wall,
                                    int(batch.reads.shape[0]))
 
         if cfg.checkpoint_dir:
-            ckpt.save_step(cfg.checkpoint_dir, step_name,
-                           jax.tree_util.tree_map(np.asarray, fit.params),
-                           fit.losses,
-                           opt_state=jax.tree_util.tree_map(
-                               np.asarray, fit.opt_state),
-                           num_iters=fit.num_iters,
-                           converged=fit.converged,
-                           nan_abort=fit.nan_abort)
+            with self.phases.phase(f"{step_name}/checkpoint"):
+                ckpt.save_step(cfg.checkpoint_dir, step_name,
+                               jax.tree_util.tree_map(np.asarray, fit.params),
+                               fit.losses,
+                               opt_state=jax.tree_util.tree_map(
+                                   np.asarray, fit.opt_state),
+                               num_iters=fit.num_iters,
+                               converged=fit.converged,
+                               nan_abort=fit.nan_abort)
         return StepOutput(fit, spec, fixed, batch, wall)
 
     def run_step1(self) -> StepOutput:
         iters = self.config.resolved_iters()
-        batch, _ = self.g1_g2_doubled_batch()
-        spec = PertModelSpec(
-            P=self.config.P, K=self.config.K, L=self.L,
-            tau_mode="beta_default", step1=True,
-            cell_chunk=self.config.cell_chunk)
+        with self.phases.phase("step1/build"):
+            batch, _ = self.g1_g2_doubled_batch()
+            spec = PertModelSpec(
+                P=self.config.P, K=self.config.K, L=self.L,
+                tau_mode="beta_default", step1=True,
+                cell_chunk=self.config.cell_chunk)
         return self._fit(spec, batch, {}, None,
                          iters["max_iter_step1"], iters["min_iter_step1"],
                          "step1")
 
     def run_step2(self, step1: StepOutput, etas: np.ndarray) -> StepOutput:
         iters = self.config.resolved_iters()
-        c1 = constrained(step1.spec, step1.fit.params, step1.fixed)
-        fixed = {
-            "beta_means": c1["beta_means"],   # pert_model.py:782-787
-            "lamb": c1["lamb"],               # pert_model.py:801 (lamb=...)
-        }
-        cond_rho = bool(self.config.rho_from_rt_prior)
-        # initial S-phase times from the real (unpadded) cells/loci only
-        t_init_real, _, _ = guess_times(jnp.asarray(self.s.reads),
-                                        jnp.asarray(etas),
-                                        float(self.config.upsilon),
-                                        loci_mask=self.s.loci_mask)
-        s = self._pad(self.s)
-        etas_padded = _pad_etas(etas, s.num_cells, s.num_loci)
-        t_init = np.pad(np.asarray(t_init_real),
-                        (0, s.num_cells - self.s.num_cells),
-                        constant_values=0.4)
-        if cond_rho:
-            # the conditioning branch the reference defined but never
-            # exercised (model_s's rho0, pert_model.py:568-570); rho has
-            # no prior term either way (Beta(1,1) logpdf = 0).  The loader
-            # only divides by the max (reference: pert_model.py:254-257),
-            # so a prior column with negative values (repli-seq log-ratios)
-            # would leave rho outside [0, 1] — clamp to the learned path's
-            # domain.
-            fixed["rho"] = jnp.clip(
-                jnp.asarray(s.rt_prior, jnp.float32), 0.0, 1.0)
-        eta_fields = self._eta_batch_fields(etas_padded)
-        batch = PertBatch(
-            reads=jnp.asarray(s.reads),
-            libs=jnp.asarray(s.libs),
-            gamma_feats=self._gamma_feats(s),
-            mask=jnp.asarray(s.cell_mask.astype(np.float32)),
-            loci_mask=_loci_mask_arr(s),
-            **eta_fields,
-        )
-        spec = PertModelSpec(
-            P=self.config.P, K=self.config.K, L=self.L,
-            tau_mode="param", step1=False, cond_beta_means=True,
-            cond_rho=cond_rho,
-            fixed_lamb=True, sparse_etas="eta_idx" in eta_fields,
-            cell_chunk=self.config.cell_chunk,
-            enum_impl=self._enum_impl())
+        with self.phases.phase("step2/build"):
+            c1 = constrained(step1.spec, step1.fit.params, step1.fixed)
+            fixed = {
+                "beta_means": c1["beta_means"],  # pert_model.py:782-787
+                "lamb": c1["lamb"],            # pert_model.py:801 (lamb=...)
+            }
+            cond_rho = bool(self.config.rho_from_rt_prior)
+            # initial S-phase times from the real (unpadded) cells/loci only
+            t_init_real, _, _ = guess_times(jnp.asarray(self.s.reads),
+                                            jnp.asarray(etas),
+                                            float(self.config.upsilon),
+                                            loci_mask=self.s.loci_mask)
+            s = self._pad(self.s)
+            etas_padded = _pad_etas(etas, s.num_cells, s.num_loci)
+            t_init = np.pad(np.asarray(t_init_real),
+                            (0, s.num_cells - self.s.num_cells),
+                            constant_values=0.4)
+            if cond_rho:
+                # the conditioning branch the reference defined but never
+                # exercised (model_s's rho0, pert_model.py:568-570); rho has
+                # no prior term either way (Beta(1,1) logpdf = 0).  The
+                # loader only divides by the max (reference:
+                # pert_model.py:254-257), so a prior column with negative
+                # values (repli-seq log-ratios) would leave rho outside
+                # [0, 1] — clamp to the learned path's domain.
+                fixed["rho"] = jnp.clip(
+                    jnp.asarray(s.rt_prior, jnp.float32), 0.0, 1.0)
+            eta_fields = self._eta_batch_fields(etas_padded)
+            batch = PertBatch(
+                reads=jnp.asarray(s.reads),
+                libs=jnp.asarray(s.libs),
+                gamma_feats=self._gamma_feats(s),
+                mask=jnp.asarray(s.cell_mask.astype(np.float32)),
+                loci_mask=_loci_mask_arr(s),
+                **eta_fields,
+            )
+            spec = PertModelSpec(
+                P=self.config.P, K=self.config.K, L=self.L,
+                tau_mode="param", step1=False, cond_beta_means=True,
+                cond_rho=cond_rho,
+                fixed_lamb=True, sparse_etas="eta_idx" in eta_fields,
+                cell_chunk=self.config.cell_chunk,
+                enum_impl=self._enum_impl())
         out = self._fit(spec, batch, fixed, t_init,
                         iters["max_iter"], iters["min_iter"], "step2")
         self._step2_data = s
         if self.config.mirror_rescue:
-            out = self._mirror_rescue(out, batch)
+            with self.phases.phase("step2/rescue"):
+                out = self._mirror_rescue(out, batch)
         else:
             # reference-faithful path: no behaviour change, but surface
             # the symptom the opt-in rescue exists for
@@ -534,14 +576,13 @@ class PertInference:
         # betas-prior width the candidates are later SCORED under — a
         # cold logspace init would optimise them against a different
         # width than the acceptance comparison uses) and the incumbent
-        # GC coefficients (basin-independent)
-        params0["beta_stds_raw"] = orig_sub["beta_stds_raw"]
-        params0["betas"] = orig_sub["betas"]
+        # GC coefficients (basin-independent).  Seeded from the numpy
+        # copies, NOT from orig_sub: fit_map DONATES the params0 buffers,
+        # and orig_sub must stay alive for the acceptance scoring below.
+        params0["beta_stds_raw"] = jnp.asarray(params_np["beta_stds_raw"])
+        params0["betas"] = jnp.asarray(params_np["betas"][cand])
 
-        def loss_fn(params, fixed_, batch_):
-            return pert_loss(spec, params, fixed_, batch_)
-
-        fit = fit_map(loss_fn, params0, (fixed, sub_batch),
+        fit = fit_map(_PertLossFn(spec=spec), params0, (fixed, sub_batch),
                       max_iter=cfg.mirror_max_iter,
                       min_iter=cfg.mirror_min_iter,
                       rel_tol=cfg.rel_tol, learning_rate=cfg.learning_rate,
@@ -575,40 +616,41 @@ class PertInference:
 
     def run_step3(self, step1: StepOutput, step2: StepOutput) -> StepOutput:
         iters = self.config.resolved_iters()
-        c1 = constrained(step1.spec, step1.fit.params, step1.fixed)
-        c2 = constrained(step2.spec, step2.fit.params, step2.fixed)
-        fixed = {
-            "beta_means": c1["beta_means"],
-            "lamb": c1["lamb"],
-            "rho": c2["rho"],                 # pert_model.py:844-851
-            "a": c2["a"],
-        }
-        etas2_real = self.build_etas_step3()
-        t_init2_real, _, _ = guess_times(jnp.asarray(self.g1.reads),
-                                         jnp.asarray(etas2_real),
-                                         float(self.config.upsilon),
-                                         loci_mask=self.g1.loci_mask)
-        g1 = self._pad(self.g1)
-        etas2 = _pad_etas(etas2_real, g1.num_cells, g1.num_loci)
-        t_init2 = np.pad(np.asarray(t_init2_real),
-                         (0, g1.num_cells - self.g1.num_cells),
-                         constant_values=0.4)
-        eta_fields = self._eta_batch_fields(etas2)
-        batch = PertBatch(
-            reads=jnp.asarray(g1.reads),
-            libs=jnp.asarray(g1.libs),
-            gamma_feats=self._gamma_feats(g1),
-            mask=jnp.asarray(g1.cell_mask.astype(np.float32)),
-            loci_mask=_loci_mask_arr(g1),
-            **eta_fields,
-        )
-        spec = PertModelSpec(
-            P=self.config.P, K=self.config.K, L=self.L,
-            tau_mode="param", step1=False, cond_beta_means=True,
-            cond_rho=True, cond_a=True, fixed_lamb=True,
-            sparse_etas="eta_idx" in eta_fields,
-            cell_chunk=self.config.cell_chunk,
-            enum_impl=self._enum_impl())
+        with self.phases.phase("step3/build"):
+            c1 = constrained(step1.spec, step1.fit.params, step1.fixed)
+            c2 = constrained(step2.spec, step2.fit.params, step2.fixed)
+            fixed = {
+                "beta_means": c1["beta_means"],
+                "lamb": c1["lamb"],
+                "rho": c2["rho"],                 # pert_model.py:844-851
+                "a": c2["a"],
+            }
+            etas2_real = self.build_etas_step3()
+            t_init2_real, _, _ = guess_times(jnp.asarray(self.g1.reads),
+                                             jnp.asarray(etas2_real),
+                                             float(self.config.upsilon),
+                                             loci_mask=self.g1.loci_mask)
+            g1 = self._pad(self.g1)
+            etas2 = _pad_etas(etas2_real, g1.num_cells, g1.num_loci)
+            t_init2 = np.pad(np.asarray(t_init2_real),
+                             (0, g1.num_cells - self.g1.num_cells),
+                             constant_values=0.4)
+            eta_fields = self._eta_batch_fields(etas2)
+            batch = PertBatch(
+                reads=jnp.asarray(g1.reads),
+                libs=jnp.asarray(g1.libs),
+                gamma_feats=self._gamma_feats(g1),
+                mask=jnp.asarray(g1.cell_mask.astype(np.float32)),
+                loci_mask=_loci_mask_arr(g1),
+                **eta_fields,
+            )
+            spec = PertModelSpec(
+                P=self.config.P, K=self.config.K, L=self.L,
+                tau_mode="param", step1=False, cond_beta_means=True,
+                cond_rho=True, cond_a=True, fixed_lamb=True,
+                sparse_etas="eta_idx" in eta_fields,
+                cell_chunk=self.config.cell_chunk,
+                enum_impl=self._enum_impl())
         out = self._fit(spec, batch, fixed, t_init2,
                         iters["max_iter_step3"], iters["min_iter_step3"],
                         "step3")
@@ -620,7 +662,12 @@ class PertInference:
     def run(self):
         """Run steps 1-3; returns (step1, step2, step3-or-None)."""
         step1 = self.run_step1()
-        etas = self.build_etas()
+        # timed separately from step2/build: at genome scale the CN prior
+        # (g1_composite / pearson_matrix over a (cells, loci, P) tensor)
+        # is its own multi-second stage (step 3's twin is timed inside
+        # step3/build because it happens there)
+        with self.phases.phase("step2/prior"):
+            etas = self.build_etas()
         step2 = self.run_step2(step1, etas)
         step3 = self.run_step3(step1, step2) if self.config.run_step3 else None
         return step1, step2, step3
@@ -640,69 +687,67 @@ def package_step_output(
     cols: ColumnConfig = ColumnConfig(),
     hmm_self_prob: Optional[float] = None,
     mirror_rescue_stats: Optional[dict] = None,
+    timer: Optional[profiling.PhaseTimer] = None,
+    phase_prefix: str = "s",
 ) -> Tuple[pd.DataFrame, pd.DataFrame]:
-    """Decode discretes + melt fitted values back to the long-form contract.
+    """Decode discretes + attach fitted values to the long-form contract.
 
     Mirrors ``package_s_output`` (reference: pert_model.py:466-538): adds
     model_cn_state, model_rep_state, model_tau, model_u, model_rho columns
     to ``cn_long`` and builds the supplementary param/loss table
-    (model_lambda, model_a, loss_g, loss_s).
+    (model_lambda, model_a, loss_g, loss_s).  The reference melts each
+    dense output into a long frame and inner-merges; here the decode
+    planes stay on device until ONE bulk fetch, and the long columns are
+    attached by array-native gathers (``data.loader.attach_dense_columns``)
+    with identical inner-join semantics.
 
     ``hmm_self_prob`` switches the per-bin argmax decode for the
     genome-smoothed Viterbi CN decode (models/hmm.py) with that
-    self-transition probability.
+    self-transition probability.  ``timer`` (optional) records the
+    decode/fetch/package phases under ``{phase_prefix}/...``.
     """
     spec, params, fixed, batch = step.spec, step.fit.params, step.fixed, step.batch
-    if hmm_self_prob is not None:
-        from scdna_replication_tools_tpu.models.pert import decode_discrete_hmm
-        chroms = data.loci.get_level_values(0)
-        restart = jnp.asarray(
-            np.r_[1.0, (chroms[1:] != chroms[:-1]).astype(np.float32)])
-        cn_map, rep_map, p_rep = decode_discrete_hmm(
-            spec, params, fixed, batch, restart, hmm_self_prob)
-    else:
-        cn_map, rep_map, p_rep = decode_discrete(spec, params, fixed, batch)
-    c = constrained(spec, params, fixed)
+    timer = timer or profiling.PhaseTimer()
+    with timer.phase(f"{phase_prefix}/decode"):
+        if hmm_self_prob is not None:
+            from scdna_replication_tools_tpu.models.pert import (
+                decode_discrete_hmm,
+            )
+            chroms = data.loci.get_level_values(0)
+            restart = jnp.asarray(
+                np.r_[1.0, (chroms[1:] != chroms[:-1]).astype(np.float32)])
+            decoded = decode_discrete_hmm(
+                spec, params, fixed, batch, restart, hmm_self_prob)
+        else:
+            decoded = decode_discrete(spec, params, fixed, batch)
+        c = constrained(spec, params, fixed)
 
     n = int(np.sum(data.cell_mask)) if data.cell_mask is not None \
         else data.num_cells
     cell_ids = list(data.cell_ids)[:n]
-    chr_vals = data.loci.get_level_values(0).astype(str)
-    start_vals = data.loci.get_level_values(1)
 
-    loci_index = pd.MultiIndex.from_arrays(
-        [chr_vals, start_vals], names=[cols.chr_col, cols.start_col])
+    with timer.phase(f"{phase_prefix}/fetch"):
+        # one bulk device->host transfer for every packaged plane
+        (cn_map, rep_map, p_rep), tau, u, rho, a_c = jax.device_get(
+            (decoded, c["tau"], c["u"], c["rho"], c["a"]))
 
-    def _melt(mat, name):
-        # loci x cells frame melted to long form, like the reference's
-        # model_cn_df/model_rep_df handling (pert_model.py:480-483)
-        df = pd.DataFrame(np.asarray(mat)[:n].T, index=loci_index,
-                          columns=pd.Index(cell_ids, name=cols.cell_col))
-        return df.melt(ignore_index=False, value_name=name).reset_index()
-
-    cn_long = cn_long.copy()
-    cn_long[cols.chr_col] = cn_long[cols.chr_col].astype(str)
-
-    out = pd.merge(cn_long, _melt(cn_map, "model_cn_state"))
-    out = pd.merge(out, _melt(rep_map, "model_rep_state"))
-    out = pd.merge(out, _melt(p_rep, "model_p_rep"))
-
-    tau_df = pd.DataFrame({cols.cell_col: cell_ids,
-                           "model_tau": np.asarray(c["tau"])[:n]})
-    u_df = pd.DataFrame({cols.cell_col: cell_ids,
-                         "model_u": np.asarray(c["u"])[:n]})
-    rho_df = pd.DataFrame({cols.chr_col: chr_vals,
-                           cols.start_col: start_vals,
-                           "model_rho": np.asarray(c["rho"])})
-    out = pd.merge(out, tau_df)
-    out = pd.merge(out, u_df)
-    out = pd.merge(out, rho_df)
+    with timer.phase(f"{phase_prefix}/package"):
+        cn_long = cn_long.copy()
+        cn_long[cols.chr_col] = cn_long[cols.chr_col].astype(str)
+        out = attach_dense_columns(
+            cn_long, cell_ids, data.loci, cols,
+            per_bin={"model_cn_state": cn_map[:n],
+                     "model_rep_state": rep_map[:n],
+                     "model_p_rep": p_rep[:n]},
+            per_cell={"model_tau": tau[:n], "model_u": u[:n]},
+            per_locus={"model_rho": rho},
+        )
 
     supp = [
         pd.DataFrame({"param": ["model_lambda"], "level": ["all"],
                       "value": [float(lamb)]}),
         pd.DataFrame({"param": ["model_a"], "level": ["all"],
-                      "value": [float(np.asarray(c["a"]).reshape(-1)[0])]}),
+                      "value": [float(np.asarray(a_c).reshape(-1)[0])]}),
         pd.DataFrame({"param": ["loss_g"] * len(losses_g),
                       "level": np.arange(len(losses_g)),
                       "value": np.asarray(losses_g, np.float64)}),

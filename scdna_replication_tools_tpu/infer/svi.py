@@ -21,8 +21,10 @@ Optimiser: Adam(lr, betas=(0.8, 0.99)) as in reference: pert_model.py:734.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
+import time
 from typing import Callable, Optional
 
 import jax
@@ -40,6 +42,10 @@ class FitResult:
     nan_abort: bool
     opt_state: object = None  # final optax state (device pytree) — persist
                               # it to make a partial fit exactly resumable
+    timings: dict = dataclasses.field(default_factory=dict)
+    # wall-clock split of this fit's host-side cost: {"trace", "compile",
+    # "fit"} seconds plus "program_cache" ("hit" when the in-process AOT
+    # cache served the compiled program — trace and compile are then 0)
 
 
 def _window_stat(losses, i, win_size):
@@ -51,8 +57,17 @@ def _window_stat(losses, i, win_size):
     return jnp.max(win) - jnp.min(win)
 
 
+# params0 / opt_state0 / losses0 are initial-value pytrees, dead the
+# moment the loop consumes them — donating them lets XLA reuse their
+# buffers for the loop carry instead of copying on entry (at the
+# 10k-cell scale pi_logits alone is ~2.8 GB; without donation every fit
+# pays that copy in HBM churn and transient footprint).  Checkpoint
+# resume stays bit-exact: donation recycles buffers, it never changes
+# values, and every caller builds these pytrees fresh per fit (pinned by
+# tests/test_donation.py).
 @functools.partial(jax.jit, static_argnames=("loss_fn", "max_iter", "min_iter",
-                                             "lr", "b1", "b2"))
+                                             "lr", "b1", "b2"),
+                   donate_argnames=("params0", "opt_state0", "losses0"))
 def _run_fit(loss_fn: Callable, params0: dict, opt_state0, losses0,
              i0, loss_args: tuple,
              max_iter: int, min_iter: int, rel_tol: float,
@@ -94,6 +109,75 @@ def make_opt_state(params: dict, learning_rate: float = 0.05,
     return optax.adam(learning_rate=learning_rate, b1=b1, b2=b2).init(params)
 
 
+# ---------------------------------------------------------------------------
+# AOT program cache: dedupe trace+compile across fits
+# ---------------------------------------------------------------------------
+#
+# jax.jit's own cache keys on the loss callable's *identity*, so two fits
+# whose programs are identical (same spec, same shapes/dtypes/shardings)
+# still retrace and recompile when the caller builds a fresh loss closure
+# each time.  The runner now passes value-hashable loss callables
+# (runner._PertLossFn) and this cache keys on (loss value, optimiser
+# statics, abstract signature of every dynamic argument) — equal programs
+# are compiled ONCE per process, and the explicit lower()/compile() split
+# also yields the trace/compile phase timings the orchestration layer
+# reports.  With the persistent compilation cache enabled (see
+# utils.profiling.enable_persistent_compile_cache), the compile() half is
+# served from disk across processes too.
+
+_PROGRAM_CACHE: "collections.OrderedDict" = collections.OrderedDict()
+_PROGRAM_CACHE_MAX = 32
+
+
+def _leaf_sig(leaf):
+    return (getattr(leaf, "shape", None), str(getattr(leaf, "dtype", None)),
+            getattr(leaf, "weak_type", None), getattr(leaf, "sharding", None))
+
+
+def _abstract_sig(tree):
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return (treedef, tuple(_leaf_sig(leaf) for leaf in leaves))
+
+
+def clear_program_cache() -> None:
+    """Drop the in-process compiled-program cache (test seam)."""
+    _PROGRAM_CACHE.clear()
+
+
+def _get_compiled(loss_fn, dynamic_args, rel_tol, statics, timings: dict):
+    """Compiled _run_fit program for this signature, timed on miss.
+
+    ``rel_tol`` is a DYNAMIC scalar (passed by keyword at lowering time,
+    so the compiled program is reusable across tolerance values); the
+    caller must invoke the result as ``compiled(*dynamic_args,
+    rel_tol=...)`` to match the lowered pytree."""
+    try:
+        key = (loss_fn, statics, _abstract_sig(dynamic_args))
+        hash(key)
+    except TypeError:
+        return None  # unhashable loss callable/sharding: fall back
+    cached = _PROGRAM_CACHE.get(key)
+    if cached is not None:
+        _PROGRAM_CACHE.move_to_end(key)
+        timings["program_cache"] = "hit"
+        return cached
+    max_iter, min_iter, lr, b1, b2 = statics
+    t0 = time.perf_counter()
+    lowered = _run_fit.lower(loss_fn, *dynamic_args,
+                             max_iter=max_iter, min_iter=min_iter,
+                             rel_tol=rel_tol, lr=lr, b1=b1, b2=b2)
+    t1 = time.perf_counter()
+    compiled = lowered.compile()
+    t2 = time.perf_counter()
+    timings["trace"] = t1 - t0
+    timings["compile"] = t2 - t1
+    timings["program_cache"] = "miss"
+    _PROGRAM_CACHE[key] = compiled
+    while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_MAX:
+        _PROGRAM_CACHE.popitem(last=False)
+    return compiled
+
+
 def fit_map(loss_fn: Callable, params0: dict, loss_args: tuple = (),
             max_iter: int = 2000, min_iter: int = 100, rel_tol: float = 1e-6,
             learning_rate: float = 0.05, b1: float = 0.8, b2: float = 0.99,
@@ -102,6 +186,16 @@ def fit_map(loss_fn: Callable, params0: dict, loss_args: tuple = (),
     """Fit ``params`` by MAP ascent of ``-loss_fn`` with reference semantics.
 
     ``loss_fn(params, *loss_args) -> scalar loss`` must be jit-traceable.
+    When ``loss_fn`` is hashable by VALUE (e.g. a frozen dataclass), fits
+    with identical programs share one trace+compile via the AOT program
+    cache; closures/lambdas still work but only dedupe by identity.
+
+    The ``params0``/``opt_state0`` pytrees (and the internal loss buffer)
+    are DONATED to the compiled program — do not reuse those exact arrays
+    after calling; ``FitResult.params``/``opt_state`` are the live
+    outputs.  Exception: on the resume path (``opt_state0`` given) the
+    inputs are defensively copied first, so a prior FitResult stays
+    usable after resuming from it.
 
     Resume: pass the ``opt_state`` of a previous partial FitResult plus
     its ``losses`` as ``losses_prefix`` — optimisation continues from
@@ -110,23 +204,54 @@ def fit_map(loss_fn: Callable, params0: dict, loss_args: tuple = (),
     loop is deterministic given params + opt state + loss history).
     """
     if opt_state0 is None:
+        params0 = jax.tree_util.tree_map(jnp.asarray, params0)
         opt_state0 = make_opt_state(params0, learning_rate, b1, b2)
+    else:
+        # resume path: the caller is handing over a previous FitResult's
+        # LIVE params/opt_state.  jnp.asarray would alias them, donation
+        # would then delete the caller's buffers, and any reuse (retry
+        # after a transient failure, checkpointing the partial fit)
+        # would hit "Array has been deleted" — copy instead.  Resumes
+        # are rare (checkpoint restarts), so the one extra copy does not
+        # erode the donation win on the hot fresh-fit path.
+        copy = functools.partial(jnp.array, copy=True)
+        params0 = jax.tree_util.tree_map(copy, params0)
+        opt_state0 = jax.tree_util.tree_map(copy, opt_state0)
     i0 = 0
     losses0 = jnp.zeros((max_iter,), jnp.float32)
     if losses_prefix is not None and len(losses_prefix) > 0:
         i0 = min(int(len(losses_prefix)), int(max_iter))
         losses0 = losses0.at[:i0].set(
             jnp.asarray(losses_prefix[:i0], jnp.float32))
-    i, params, opt_state, losses, converged, is_nan = _run_fit(
-        loss_fn, params0, opt_state0, losses0, i0, loss_args,
-        int(max_iter), int(min_iter),
-        float(rel_tol), float(learning_rate), float(b1), float(b2))
+    i0 = jnp.asarray(i0, jnp.int32)
+
+    rel_tol = float(rel_tol)
+    statics = (int(max_iter), int(min_iter),
+               float(learning_rate), float(b1), float(b2))
+    dynamic_args = (params0, opt_state0, losses0, i0, loss_args)
+    timings: dict = {"trace": 0.0, "compile": 0.0}
+    compiled = _get_compiled(loss_fn, dynamic_args, rel_tol, statics,
+                             timings)
+
+    t0 = time.perf_counter()
+    if compiled is not None:
+        out = compiled(*dynamic_args, rel_tol=rel_tol)
+    else:
+        timings["program_cache"] = "uncacheable"
+        out = _run_fit(loss_fn, *dynamic_args,
+                       max_iter=statics[0], min_iter=statics[1],
+                       rel_tol=rel_tol, lr=statics[2], b1=statics[3],
+                       b2=statics[4])
+    i, params, opt_state, losses, converged, is_nan = out
     n = int(i)
+    losses_host = np.asarray(losses)[:n]
+    timings["fit"] = time.perf_counter() - t0
     return FitResult(
         params=params,
-        losses=np.asarray(losses)[:n],
+        losses=losses_host,
         num_iters=n,
         converged=bool(converged),
         nan_abort=bool(is_nan),
         opt_state=opt_state,
+        timings=timings,
     )

@@ -786,14 +786,20 @@ def slice_cells(params: dict, batch: PertBatch, idx) -> tuple:
 def _decode_slabs(spec: PertModelSpec, batch: PertBatch,
                   cell_chunk) -> list:
     """Cell-index slabs for the chunked decodes.  ``cell_chunk`` None
-    sizes slabs so one joint tensor stays under _DECODE_SLAB_BYTES."""
+    sizes slabs so one joint tensor stays under _DECODE_SLAB_BYTES.
+
+    Every slab has the SAME length (the last one clamps its tail indices
+    to the final cell, and the caller trims the duplicate rows after
+    concatenation) so the jit-compiled slab program is traced and
+    compiled exactly once per (spec, shape) and reused for every slab —
+    a ragged tail slab would be a second program build for one pass."""
     num_cells, num_loci = batch.reads.shape
     if cell_chunk is None:
         per_cell = num_loci * spec.P * 2 * 4
         cell_chunk = max(1, _DECODE_SLAB_BYTES // max(per_cell, 1))
     if cell_chunk >= num_cells:
         return [None]  # single pass, no slicing
-    return [np.arange(i, min(i + cell_chunk, num_cells))
+    return [np.minimum(np.arange(i, i + cell_chunk), num_cells - 1)
             for i in range(0, num_cells, cell_chunk)]
 
 
@@ -805,6 +811,24 @@ def p_rep_marginal(joint: jnp.ndarray) -> jnp.ndarray:
     flat = joint.reshape(joint.shape[:-2] + (P * 2,))
     norm = logsumexp(flat, axis=-1)
     return jnp.exp(logsumexp(joint[..., 1], axis=-1) - norm)
+
+
+@functools.partial(jax.jit, static_argnames=("spec",))
+def _decode_slab(spec: PertModelSpec, params: dict, fixed: dict,
+                 batch: PertBatch):
+    """One compiled decode pass: joint logits -> (cn, rep, p_rep).
+
+    jit-compiled with the (hashable) spec static, so equal-shaped slabs —
+    and equal-shaped packaging calls across steps — share one traced and
+    compiled program instead of dispatching the whole decode op-by-op
+    per slab (the r5 profile showed the eager decode paying host dispatch
+    per primitive at genome scale)."""
+    joint = model_joint_logits(spec, params, fixed, batch)
+    flat = joint.reshape(joint.shape[:-2] + (spec.P * 2,))
+    best = jnp.argmax(flat, axis=-1)
+    return ((best // 2).astype(jnp.int32),
+            (best % 2).astype(jnp.int32),
+            p_rep_marginal(joint))
 
 
 def decode_discrete(spec: PertModelSpec, params: dict, fixed: dict,
@@ -822,23 +846,23 @@ def decode_discrete(spec: PertModelSpec, params: dict, fixed: dict,
     slabs to keep each (chunk, loci, P, 2) joint tensor under
     ~_DECODE_SLAB_BYTES — without this, packaging a 10k-cell fit would
     materialise the very enumeration tensor the fused training kernel
-    exists to avoid.
+    exists to avoid.  One compiled program serves every slab, and the
+    outputs stay ON DEVICE — callers fetch all three planes in one bulk
+    device->host transfer (see ``infer.runner.package_step_output``)
+    instead of a per-slab/per-plane trickle.
 
-    Returns (cn_map, rep_map, p_rep) each (cells, loci).
+    Returns (cn_map, rep_map, p_rep) each (cells, loci), on device.
     """
+    num_cells = batch.reads.shape[0]
     outs = []
     for idx in _decode_slabs(spec, batch, cell_chunk):
         p, b = (params, batch) if idx is None \
             else slice_cells(params, batch, idx)
-        joint = model_joint_logits(spec, p, fixed, b)
-        flat = joint.reshape(joint.shape[:-2] + (spec.P * 2,))
-        best = jnp.argmax(flat, axis=-1)
-        outs.append(((best // 2).astype(jnp.int32),
-                     (best % 2).astype(jnp.int32),
-                     p_rep_marginal(joint)))
+        outs.append(_decode_slab(spec, p, fixed, b))
     if len(outs) == 1:
         return outs[0]
-    return tuple(jnp.concatenate([o[i] for o in outs], axis=0)
+    # the tail slab clamps its indices to the last cell: trim duplicates
+    return tuple(jnp.concatenate([o[i] for o in outs], axis=0)[:num_cells]
                  for i in range(3))
 
 
@@ -859,6 +883,7 @@ def decode_discrete_hmm(spec: PertModelSpec, params: dict, fixed: dict,
     """
     from scdna_replication_tools_tpu.models.hmm import hmm_decode
 
+    num_cells = batch.reads.shape[0]
     outs = []
     for idx in _decode_slabs(spec, batch, cell_chunk):
         p, b = (params, batch) if idx is None \
@@ -867,5 +892,6 @@ def decode_discrete_hmm(spec: PertModelSpec, params: dict, fixed: dict,
         outs.append(hmm_decode(joint, restart, self_prob))
     if len(outs) == 1:
         return outs[0]
-    return tuple(jnp.concatenate([o[i] for o in outs], axis=0)
+    # equal-length slabs (tail clamped): trim the duplicate rows
+    return tuple(jnp.concatenate([o[i] for o in outs], axis=0)[:num_cells]
                  for i in range(len(outs[0])))

@@ -169,6 +169,7 @@ def pert_simulator(
     gc_col: str = "gc",
     input_cn_col: str = "true_somatic_cn",
     seed: int = 0,
+    tau_range: Optional[Tuple[float, float]] = None,
 ) -> Tuple[pd.DataFrame, pd.DataFrame]:
     """Simulate S- and G1-phase read counts for cells with known CN.
 
@@ -176,6 +177,14 @@ def pert_simulator(
     (reference: pert_simulator.py:285-418): one RT column per clone;
     outputs gain true_reads_norm, true_reads_raw, true_rep, true_p_rep,
     true_t and true_total_cn columns.
+
+    ``tau_range`` (optional) draws each cell's true S-phase time uniform
+    in [lo, hi] instead of the reference's uniform [0, 1] — e.g. a
+    late-S-heavy cohort (``(0.85, 0.97)``) whose near-fully-replicated
+    profiles are exactly the regime where ``guess_times``'s skew
+    heuristic lands in the wrong mirror basin (the workload
+    ``tools/accuracy_sweep.py --mirror-stress`` uses to exercise an
+    ACCEPTED mirror rescue rather than its no-op path).
     """
     df_s = df_s.copy()
     df_g = df_g.copy()
@@ -202,9 +211,16 @@ def pert_simulator(
         libs = libs_map.reindex(cn_mat.index).to_numpy(np.int32)
 
         key, sub = jax.random.split(key)
+        tau = None
+        if tau_range is not None:
+            key, k_tau = jax.random.split(key)
+            lo, hi = float(tau_range[0]), float(tau_range[1])
+            tau = lo + (hi - lo) * jax.random.uniform(
+                k_tau, (cn_mat.shape[0],))
         sim = simulate_s_reads(sub, cn_mat.to_numpy(np.float32), gammas,
                                jnp.asarray(rho), jnp.asarray(libs),
-                               num_reads, lamb, betas, a, num_libraries=L)
+                               num_reads, lamb, betas, a, num_libraries=L,
+                               tau=tau)
 
         def _melt(arr, name):
             m = pd.DataFrame(np.asarray(arr), index=cn_mat.index,

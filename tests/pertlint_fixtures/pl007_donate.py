@@ -1,0 +1,62 @@
+# pertlint test fixture: PL007 undonated-init-buffers.  Parsed, never
+# imported.  The rule fires on jit entry points whose signature carries
+# initial-value pytree names (params0 / opt_state0 / losses0 / *_init)
+# when the jit wrapping has no donate_argnums/donate_argnames.
+import functools
+
+import jax
+
+
+@jax.jit
+def bare_decorated(params0, data):  # expect: PL007
+    return params0, data
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def partial_no_donate(params0, opt_state0, n):  # expect: PL007
+    return params0, opt_state0, n
+
+
+@functools.partial(jax.jit, static_argnames=("n",),
+                   donate_argnames=("params0", "opt_state0"))
+def partial_donating(params0, opt_state0, n):   # donation present: clean
+    return params0, opt_state0, n
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def donating_by_index(state0, data):            # donation present: clean
+    return state0, data
+
+
+@jax.jit
+def plain_params_ok(params, batch):   # 'params' is not an init-value name
+    return params, batch
+
+
+@jax.jit
+def suppressed(losses0):  # pertlint: disable=PL007
+    return losses0
+
+
+def step_fn(carry0, xs):
+    return carry0, xs
+
+
+wrapped = jax.jit(step_fn)  # expect: PL007
+wrapped_ok = jax.jit(step_fn, donate_argnums=(0,))   # donates: clean
+
+
+def loop_body(state_init):
+    return state_init
+
+
+looped = functools.partial(jax.jit, static_argnums=())(loop_body)  # expect: PL007
+
+
+def shard_mapped(params0):
+    return params0
+
+
+# shard_map has no donation contract — out of scope for the rule
+sharded = jax.shard_map(shard_mapped, mesh=None, in_specs=None,
+                        out_specs=None)

@@ -40,6 +40,7 @@ FIXTURES = {
     "PL004": FIXTURE_DIR / "ops" / "pl004_dtype_drift.py",
     "PL005": FIXTURE_DIR / "pl005_rng.py",
     "PL006": FIXTURE_DIR / "pl006_jit_in_loop.py",
+    "PL007": FIXTURE_DIR / "pl007_donate.py",
 }
 
 
@@ -179,6 +180,8 @@ def _seed_violation(rule_id):
         "PL005": "\ndef seeded(n):\n    return np.random.rand(n)\n",
         "PL006": ("\ndef seeded(fns):\n    for f in fns:\n"
                   "        g = jax.jit(f)\n    return g\n"),
+        "PL007": ("\n@jax.jit\ndef seeded(params0):\n"
+                  "    return params0\n"),
     }[rule_id]
 
 

@@ -1,0 +1,96 @@
+"""Phase-report schema: the end-to-end wall must be accounted for.
+
+The perf story of the orchestration layer rests on the ``phases`` dict
+(`scRT.phase_report`, passed through to the bench JSON artifacts): every
+stage of the pipeline (clone prep, load, per-step build/h2d/trace/
+compile/fit, decode, packaging) is a named, measured phase.  This smoke
+pins the schema — required keys present, phases non-negative and
+non-overlapping enough to sum to >=95% of the measured wall — so the
+JSON surface cannot silently rot.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from scdna_replication_tools_tpu.api import scRT
+
+REQUIRED_PHASES = [
+    "clone_prep", "load",
+    "step1/build", "step1/h2d", "step1/fit",
+    "step2/prior", "step2/build", "step2/h2d", "step2/fit",
+    "step3/build", "step3/h2d", "step3/fit",
+    "package_s/decode", "package_s/fetch", "package_s/package",
+    "package_g1/decode", "package_g1/fetch", "package_g1/package",
+]
+
+
+@pytest.fixture(scope="module")
+def phase_run(synthetic_frames):
+    df_s, df_g = synthetic_frames
+    df_s = df_s.assign(reads=np.random.default_rng(0)
+                       .poisson(40, len(df_s)).astype(float),
+                       state=df_s.true_somatic_cn.astype(int),
+                       copy=df_s.true_somatic_cn)
+    df_g = df_g.assign(reads=np.random.default_rng(1)
+                       .poisson(40, len(df_g)).astype(float),
+                       state=df_g.true_somatic_cn.astype(int),
+                       copy=df_g.true_somatic_cn)
+    scrt = scRT(df_s, df_g, clone_col="clone_id",
+                cn_prior_method="g1_clones", max_iter=10, min_iter=5,
+                run_step3=True)
+    t0 = time.perf_counter()
+    scrt.infer(level="pert")
+    wall = time.perf_counter() - t0
+    return scrt, wall
+
+
+def test_phase_report_schema(phase_run):
+    scrt, _ = phase_run
+    report = scrt.phase_report
+    assert report is not None
+    missing = [k for k in REQUIRED_PHASES if k not in report]
+    assert not missing, f"phase report lost keys: {missing}"
+    # trace/compile keys exist per step (0.0 on a program-cache hit)
+    for step in ("step1", "step2", "step3"):
+        assert f"{step}/trace" in report
+        assert f"{step}/compile" in report
+    assert all(v >= 0.0 for v in report.values())
+
+
+def test_phases_cover_95_percent_of_wall(phase_run):
+    scrt, wall = phase_run
+    report = scrt.phase_report
+    accounted = report["total_accounted"]
+    assert accounted <= wall * 1.02, \
+        "phases overlap: accounted exceeds the measured wall"
+    assert accounted >= 0.95 * wall, \
+        (f"phases cover only {accounted / wall:.1%} of the wall "
+         f"({accounted:.2f}s of {wall:.2f}s) — a stage went unaccounted")
+
+
+@pytest.mark.slow
+def test_full_pipeline_bench_json_carries_phases(tmp_path):
+    """The bench artifact surface: tiny genome workload end to end
+    through tools/full_pipeline_bench.run, asserting the JSON contract
+    the committed artifacts (and tpu_window_runner) rely on."""
+    import json
+    import pathlib
+    import sys
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]
+                           / "tools"))
+    import full_pipeline_bench as fpb
+
+    out_path = tmp_path / "bench.json"
+    fpb.main(["--cells", "6", "--g1-cells", "3",
+              "--bin-size", "20000000", "--max-iter", "6",
+              "--min-iter", "3", "--run-step3",
+              "--compile-cache", str(tmp_path / "cache"),
+              "--out", str(out_path)])
+    out = json.loads(out_path.read_text())
+    assert "phases" in out and out["phases"], "bench JSON lost its phases"
+    assert out["phase_coverage_of_wall"] >= 0.95
+    assert out["non_fit_wall_seconds"] >= 0.0
+    assert "step2/fit" in out["phases"]

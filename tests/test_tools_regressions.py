@@ -12,6 +12,7 @@ so these tests stay in the fast tier.
 """
 
 import importlib.util
+import json
 import pathlib
 import sys
 
@@ -81,6 +82,44 @@ def test_bench_presets_cover_every_sentinel_arg():
         for name in ("cells", "loci", "iters", "baseline_iters",
                      "probe_timeout"):
             assert getattr(args, name) is not None, (budget, name)
+
+
+def test_bench_baseline_cache_roundtrip(tmp_path):
+    """write_baseline_cache -> load_cached_baseline must roundtrip by
+    shape key, replace same-shape entries, and miss on other shapes —
+    the mechanism that keeps the CPU-fallback path off the ~20-minute
+    torch-twin measurement (VERDICT r5 next-round #1)."""
+    bench = _load("bench_under_test", "bench.py")
+    path = tmp_path / "baseline.json"
+    args = bench._parse_args(["--budget", "fast"])
+    assert bench.load_cached_baseline(args, path=path) is None
+    bench.write_baseline_cache(args, 1.234, -42.0, path=path)
+    entry = bench.load_cached_baseline(args, path=path)
+    assert entry is not None and entry["sec_per_iter"] == 1.234
+    # replacement, not duplication
+    bench.write_baseline_cache(args, 2.0, -41.0, path=path)
+    data = json.loads(path.read_text())
+    assert len(data["entries"]) == 1
+    assert bench.load_cached_baseline(args, path=path)["sec_per_iter"] == 2.0
+    # a different shape misses
+    other = bench._parse_args(["--budget", "full"])
+    assert bench.load_cached_baseline(other, path=path) is None
+
+
+def test_bench_committed_baseline_covers_both_budgets():
+    """The committed artifact must hit for the budget presets — the
+    exact shapes the driver and the window runner invoke — so a dead
+    tunnel never re-pays the twin measurement."""
+    bench = _load("bench_under_test", "bench.py")
+    for budget in bench.BUDGETS:
+        args = bench._parse_args(["--budget", budget])
+        entry = bench.load_cached_baseline(args)
+        assert entry is not None, (
+            f"artifacts/BENCH_BASELINE_torch_twin.json has no entry for "
+            f"the {budget!r} preset shape "
+            f"({args.cells}x{args.loci}) — regenerate with "
+            f"--write-baseline-cache")
+        assert entry["sec_per_iter"] > 0
 
 
 if __name__ == "__main__":

@@ -76,7 +76,7 @@ def _round_or_none(x, nd=4):
 
 
 def run_config(num_reads, lamb, a, cells_per_clone, num_loci, max_iter,
-               seed, mirror_rescue=False):
+               seed, mirror_rescue=False, tau_range=None):
     import pandas as pd
 
     from scdna_replication_tools_tpu.api import scRT
@@ -85,7 +85,8 @@ def run_config(num_reads, lamb, a, cells_per_clone, num_loci, max_iter,
     df_s, df_g = tut.make_input_frames(
         num_loci=num_loci, cells_per_clone=cells_per_clone, seed=seed)
     sim_s, sim_g = tut.simulate_pert_frames(
-        df_s, df_g, num_reads=num_reads, lamb=lamb, a=a, seed=seed + 1)
+        df_s, df_g, num_reads=num_reads, lamb=lamb, a=a, seed=seed + 1,
+        tau_range=tau_range)
 
     t0 = time.perf_counter()
     scrt = scRT(sim_s, sim_g, cn_prior_method="g1_clones",
@@ -102,6 +103,7 @@ def run_config(num_reads, lamb, a, cells_per_clone, num_loci, max_iter,
         "num_reads": num_reads, "lamb": lamb, "a": a,
         "cells_per_clone": cells_per_clone, "num_loci": num_loci,
         "max_iter": max_iter, "seed": seed,
+        "tau_range": list(tau_range) if tau_range else None,
         "mirror_rescue": bool(mirror_rescue),
         "mirror_rescue_stats": getattr(scrt, "mirror_rescue_stats", None),
         "rep_accuracy": _round_or_none(
@@ -111,6 +113,51 @@ def run_config(num_reads, lamb, a, cells_per_clone, num_loci, max_iter,
         "tau_corr": _round_or_none(np.corrcoef(
             per_cell.model_tau, per_cell.true_t)[0, 1]),
         "lambda_abs_err": _round_or_none(abs(model_lambda - lamb)),
+        "wall_seconds": round(wall, 1),
+    }
+
+
+def run_genome_mirror_config(num_cells, num_g1, bin_size, max_iter, seed,
+                             mirror_rescue):
+    """Mirror-stress arm: the genome workload (full_pipeline_bench's
+    generative model, mcf7rt RT profile) at reduced scale.
+
+    The tutorial simulator's sin-wave RT profile is informative enough
+    that ``guess_times`` never lands in the wrong mirror basin — its
+    rescue arm is structurally a no-op twin (ACCURACY_r05_cpu.json:
+    every config candidates<=1, accepted=0).  The genome workload's
+    flatter empirical RT profile DOES produce wrong-basin boundary fits
+    (the r5 A/B pair records 5 candidates / 5 accepted at 100 cells), so
+    this config exercises the acceptance path for real.  Metrics are the
+    subset the genome truth supports: tau_corr + cn_accuracy (its truth
+    frame has no per-bin replication states).
+    """
+    from full_pipeline_bench import make_genome_workload
+
+    from scdna_replication_tools_tpu.api import scRT
+
+    df_s, df_g, truth_s = make_genome_workload(num_cells, num_g1,
+                                               bin_size=bin_size, seed=seed)
+    t0 = time.perf_counter()
+    scrt = scRT(df_s, df_g, cn_prior_method="g1_clones",
+                max_iter=max_iter, min_iter=100,
+                mirror_rescue=mirror_rescue)
+    cn_s_out, supp_s, _, _ = scrt.infer(level="pert")
+    wall = time.perf_counter() - t0
+
+    per_cell = cn_s_out.drop_duplicates("cell_id").set_index("cell_id")
+    merged = per_cell.join(truth_s.set_index("cell_id"))
+    return {
+        "workload": "genome_mirror_stress",
+        "num_cells": num_cells, "num_g1": num_g1, "bin_size": bin_size,
+        "max_iter": max_iter, "seed": seed,
+        "mirror_rescue": bool(mirror_rescue),
+        "mirror_rescue_stats": getattr(scrt, "mirror_rescue_stats", None),
+        "rep_accuracy": None,   # genome truth has no per-bin rep states
+        "cn_accuracy": _round_or_none(
+            (cn_s_out.model_cn_state == cn_s_out.state).mean()),
+        "tau_corr": _round_or_none(np.corrcoef(
+            merged.model_tau, merged.true_t)[0, 1]),
         "wall_seconds": round(wall, 1),
     }
 
@@ -127,6 +174,15 @@ def main(argv=None):
     ap.add_argument("--mirror-rescue", action="store_true",
                     help="also run every coverage with the mirror-basin "
                          "rescue enabled, for a paired comparison")
+    ap.add_argument("--mirror-stress", action="store_true",
+                    help="append a genome-workload configuration (the "
+                         "empirical mcf7rt profile, 64 cells) run with "
+                         "rescue off AND on — unlike the tutorial "
+                         "simulator's highly informative sin-wave RT "
+                         "profile (whose rescue arm is a structural "
+                         "no-op twin), this workload actually puts "
+                         "guess_times in the wrong mirror basin, so the "
+                         "rescue arm records accepted > 0")
     ap.add_argument("--out", default=None)
     ap.add_argument("--platform", default="ambient",
                     choices=["ambient", "cpu"])
@@ -143,6 +199,14 @@ def main(argv=None):
                            cells_per_clone=args.cells_per_clone,
                            num_loci=args.loci, max_iter=args.max_iter,
                            seed=args.seed, mirror_rescue=rescue)
+            print(json.dumps(r))
+            results.append(r)
+    if args.mirror_stress:
+        for rescue in (False, True):
+            r = run_genome_mirror_config(
+                num_cells=64, num_g1=16, bin_size=2_000_000,
+                max_iter=args.max_iter, seed=args.seed,
+                mirror_rescue=rescue)
             print(json.dumps(r))
             results.append(r)
 

@@ -80,10 +80,15 @@ def make_workload(num_cells=40, num_loci=150, seed=11):
 def fit_jax(cn_s, cn_g, max_iter):
     from scdna_replication_tools_tpu.api import scRT
 
+    # mirror_rescue off: this tool's single job is a like-for-like
+    # trajectory against the reference, which has no rescue mechanism
+    # (the rescue is strictly objective-improving, so leaving it on
+    # would bias our side of the final-loss comparison favourably)
     scrt = scRT(cn_s.copy(), cn_g.copy(), input_col="reads",
                 clone_col="clone_id", assign_col="copy", rt_prior_col=None,
                 cn_state_col="state", gc_col="gc",
-                cn_prior_method="g1_clones", max_iter=max_iter)
+                cn_prior_method="g1_clones", max_iter=max_iter,
+                mirror_rescue=False)
     out_s, supp_s, out_g, supp_g = scrt.infer(level="pert")
     loss = supp_s.loc[supp_s["param"] == "loss_s", "value"].astype(float)
     return out_s, float(loss.iloc[-1])

@@ -1,6 +1,7 @@
 """Rule modules — importing this package registers every rule."""
 
 from tools.pertlint.rules import (  # noqa: F401
+    donate,
     dtype_drift,
     host_sync,
     jit_in_loop,

@@ -1,0 +1,139 @@
+"""PL007: jit entry point takes initial-value pytrees without donation.
+
+A jit-compiled optimisation entry that takes *initial-value* pytree
+arguments — the ``params0`` / ``opt_state0`` / ``losses0`` / ``*_init``
+naming convention marks buffers that are dead the moment the compiled
+program consumes them — should donate those arguments
+(``donate_argnums`` / ``donate_argnames``).  Without donation XLA copies
+every such buffer on entry: at the package's 10k-cell scale the
+``pi_logits`` plane alone is ~2.8 GB of pointless HBM churn per fit
+(the lineage of this rule is ``infer/svi.py:_run_fit``, which ran
+undonated through round 5).
+
+Precision contract (what keeps this rule quiet on correct code):
+
+* only parameter NAMES following the initial-value convention trigger —
+  a stem in {params, opt_state, state, losses, carry, buffers} with a
+  ``0`` / ``_0`` / ``_init`` suffix.  A plain ``params`` argument (e.g.
+  a decode entry that must NOT donate, because the caller reuses the
+  fitted params across slabs) never fires;
+* any ``donate_argnums``/``donate_argnames`` on the jit wrapping —
+  regardless of which arguments it names — silences the rule: the
+  author has made a donation decision;
+* only ``jit``/``pjit`` wrappings are inspected (donation is a jit
+  contract; ``shard_map`` has no such kwarg).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional
+
+from tools.pertlint.core import Finding, Rule, register
+
+_STEMS = ("params", "opt_state", "state", "losses", "carry", "buffers")
+_INIT_VALUE = re.compile(rf"^(?:{'|'.join(_STEMS)})(?:0|_0|_init)$")
+
+_DONATE_KWARGS = {"donate_argnums", "donate_argnames"}
+_JIT_NAMES = {"jit", "pjit"}
+
+
+def _tail(expr: ast.AST) -> Optional[str]:
+    """'jit' for ``jit`` / ``jax.jit`` / ``jax.experimental.pjit.pjit``."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def _jit_call(call: ast.Call) -> bool:
+    """Does ``call`` build a jit/pjit wrapper (directly or via partial)?"""
+    if _tail(call.func) in _JIT_NAMES:
+        return True
+    return (_tail(call.func) == "partial" and call.args
+            and _tail(call.args[0]) in _JIT_NAMES)
+
+
+def _donates(call: Optional[ast.Call]) -> bool:
+    if call is None:
+        return False  # bare ``@jax.jit`` — no kwargs at all
+    return any(kw.arg in _DONATE_KWARGS for kw in call.keywords)
+
+
+def _init_value_args(func: ast.AST) -> List[str]:
+    a = func.args
+    names = [arg.arg for arg in
+             list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+    return [n for n in names if _INIT_VALUE.match(n)]
+
+
+@register
+class UndonatedInitBuffers(Rule):
+    id = "PL007"
+    name = "undonated-init-buffers"
+    severity = "error"
+    description = ("jit entry point takes initial-value pytree arguments "
+                   "(params0/opt_state0/.../*_init) without "
+                   "donate_argnums/donate_argnames — every fit copies "
+                   "those buffers on entry")
+
+    def check(self, ctx) -> Iterable[Finding]:
+        funcs = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                funcs.setdefault(node.name, []).append(node)
+                yield from self._check_decorated(ctx, node)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _jit_call(node):
+                # jax.jit(f, ...) / partial(jax.jit, ...) applied directly
+                yield from self._check_call_site(ctx, node, node, funcs)
+            elif isinstance(node.func, ast.Call) and _jit_call(node.func):
+                # partial(jax.jit, ...)(f): donation kwargs live on the
+                # inner partial call, the wrapped fn on the outer one
+                yield from self._check_call_site(ctx, node, node.func,
+                                                 funcs)
+
+    def _message(self, func_name: str, init_args: List[str]) -> str:
+        return (f"jit wrapping of {func_name!r} takes initial-value "
+                f"pytree argument(s) {', '.join(sorted(init_args))} "
+                f"without donate_argnums/donate_argnames; donate them "
+                f"(dead after entry by the 0/_init naming convention) "
+                f"or rename if the caller really reuses the buffers")
+
+    def _check_decorated(self, ctx, func) -> Iterable[Finding]:
+        init_args = _init_value_args(func)
+        if not init_args:
+            return
+        for dec in func.decorator_list:
+            call = dec if isinstance(dec, ast.Call) else None
+            is_jit = (_tail(dec) in _JIT_NAMES if call is None
+                      else _jit_call(call))
+            if is_jit and not _donates(call):
+                yield self.finding(ctx, func,
+                                   self._message(func.name, init_args))
+                return  # one finding per function, not per decorator
+
+    def _check_call_site(self, ctx, call: ast.Call, wrapper_call: ast.Call,
+                         funcs) -> Iterable[Finding]:
+        # resolve the wrapped same-module function by name from ``call``'s
+        # args; donation kwargs are read from ``wrapper_call`` (the same
+        # node for jax.jit(f, ...), the inner call for partial(...)(f))
+        wrapped = None
+        for arg in call.args:
+            if isinstance(arg, ast.Name) and arg.id in funcs:
+                wrapped = arg.id
+                break
+        if wrapped is None or _donates(wrapper_call) or _donates(call):
+            return
+        for func in funcs[wrapped]:
+            if any(d for d in func.decorator_list):
+                continue  # decorated defs are handled above
+            init_args = _init_value_args(func)
+            if init_args:
+                yield self.finding(ctx, call,
+                                   self._message(wrapped, init_args))
+                return

@@ -1,10 +1,10 @@
 #!/bin/bash
-# Watch for a TPU tunnel window and run the queued round-5 measurements
+# Watch for a TPU tunnel window and run the queued round-6 measurements
 # the moment one opens.  The tunnel drops for hours at a time (see
-# artifacts/TPU_PROBE_r05.log); a hung backend call blocks forever with
+# artifacts/TPU_PROBE_r06.log); a hung backend call blocks forever with
 # ~0 CPU, so every step runs under a hard timeout and the probe gates
 # each attempt.  Artifacts land in artifacts/; progress is appended to
-# artifacts/TPU_PROBE_r05.log.
+# artifacts/TPU_PROBE_r06.log.
 #
 # Battery (in value order; each is skipped once its artifact exists):
 #   1. 300-iter kernel A/B (sparse/dense/xla) — noise-tight ms/iter
@@ -17,7 +17,7 @@
 #      capped at MAX_10K_TRIES so it cannot pin the runner forever
 set -u
 cd "$(dirname "$0")/.."
-LOG=artifacts/TPU_PROBE_r05.log
+LOG=artifacts/TPU_PROBE_r06.log
 MAX_10K_TRIES=3
 tries_10k=0
 stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
@@ -78,45 +78,45 @@ battery() {  # returns 0 only if every step it attempted succeeded
     # --budget full: keep the production-shaped sizes on TPU (bench.py
     # defaults to --budget fast so the bare harness invocation can't
     # time out like BENCH_r05's rc=124)
-    run_one BENCH_r05_tpu_300iter device_platform 900 \
+    run_one BENCH_r06_tpu_300iter device_platform 900 \
         python bench.py --platform tpu --budget full --iters 300 --skip-baseline || return 1
-    run_one BENCH_r05_tpu_10k device_platform 1200 \
+    run_one BENCH_r06_tpu_10k device_platform 1200 \
         python bench.py --platform tpu --budget full --cells 10000 --iters 50 --skip-baseline || return 1
-    run_one FULL_PIPELINE_r05_rescue_tpu platform 1500 \
+    run_one FULL_PIPELINE_r06_rescue_tpu platform 1500 \
         python tools/full_pipeline_bench.py --run-step3 --mirror-rescue \
-            --out artifacts/FULL_PIPELINE_r05_rescue_tpu.json || return 1
-    run_one FULL_PIPELINE_r05_5k_tpu platform 3600 \
+            --out artifacts/FULL_PIPELINE_r06_rescue_tpu.json || return 1
+    run_one FULL_PIPELINE_r06_5k_tpu platform 3600 \
         python tools/full_pipeline_bench.py --cells 5000 --g1-cells 500 \
             --run-step3 --mirror-rescue \
-            --out artifacts/FULL_PIPELINE_r05_5k_tpu.json || return 1
-    run_one FULL_PIPELINE_r05_20kb_tpu platform 2400 \
+            --out artifacts/FULL_PIPELINE_r06_5k_tpu.json || return 1
+    run_one FULL_PIPELINE_r06_20kb_tpu platform 2400 \
         python tools/full_pipeline_bench.py --cells 250 --g1-cells 60 \
             --bin-size 20000 --run-step3 --mirror-rescue \
-            --out artifacts/FULL_PIPELINE_r05_20kb_tpu.json || return 1
-    if [ ! -s artifacts/FULL_PIPELINE_r05_10k_tpu.json ] \
+            --out artifacts/FULL_PIPELINE_r06_20kb_tpu.json || return 1
+    if [ ! -s artifacts/FULL_PIPELINE_r06_10k_tpu.json ] \
             && [ "$tries_10k" -lt "$MAX_10K_TRIES" ]; then
         tries_10k=$((tries_10k + 1))
-        run_one FULL_PIPELINE_r05_10k_tpu platform 7200 \
+        run_one FULL_PIPELINE_r06_10k_tpu platform 7200 \
             python tools/full_pipeline_bench.py --cells 10000 --g1-cells 1000 \
                 --run-step3 --mirror-rescue --cell-chunk 2500 \
-                --out artifacts/FULL_PIPELINE_r05_10k_tpu.json || return 1
+                --out artifacts/FULL_PIPELINE_r06_10k_tpu.json || return 1
     fi
     return 0
 }
 
 core_done() {
-    [ -s artifacts/BENCH_r05_tpu_300iter.json ] \
-        && [ -s artifacts/BENCH_r05_tpu_10k.json ] \
-        && [ -s artifacts/FULL_PIPELINE_r05_rescue_tpu.json ] \
-        && [ -s artifacts/FULL_PIPELINE_r05_5k_tpu.json ] \
-        && [ -s artifacts/FULL_PIPELINE_r05_20kb_tpu.json ]
+    [ -s artifacts/BENCH_r06_tpu_300iter.json ] \
+        && [ -s artifacts/BENCH_r06_tpu_10k.json ] \
+        && [ -s artifacts/FULL_PIPELINE_r06_rescue_tpu.json ] \
+        && [ -s artifacts/FULL_PIPELINE_r06_5k_tpu.json ] \
+        && [ -s artifacts/FULL_PIPELINE_r06_20kb_tpu.json ]
 }
 
 for attempt in $(seq 1 200); do
     if probe; then
         echo "$(stamp) window-runner: probe ok (attempt ${attempt}) - running battery" >> "$LOG"
         battery || true   # a failed step still falls through to sleep
-        if core_done && { [ -s artifacts/FULL_PIPELINE_r05_10k_tpu.json ] \
+        if core_done && { [ -s artifacts/FULL_PIPELINE_r06_10k_tpu.json ] \
                           || [ "$tries_10k" -ge "$MAX_10K_TRIES" ]; }; then
             echo "$(stamp) window-runner: battery complete (10k tries=${tries_10k})" >> "$LOG"
             exit 0
