@@ -355,6 +355,14 @@ def _parse_args(argv=None):
                     help="'auto' probes the ambient backend in a "
                          "subprocess and falls back to cpu")
     ap.add_argument("--probe-timeout", type=int, default=None)
+    ap.add_argument("--telemetry", default="none",
+                    help="structured JSONL run log for this bench "
+                         "invocation (obs/runlog.py): a path, 'auto' "
+                         "(repo-local .pert_runs/), or 'none' (default — "
+                         "the microbench artifact is the JSON line; the "
+                         "run log adds the run_start topology envelope "
+                         "and a bench_result event for fleet-wide "
+                         "collection)")
     ap.add_argument("--fallback-reason", default=None,
                     help=argparse.SUPPRESS)  # set by the re-exec path only
     return apply_budget(ap.parse_args(argv))
@@ -452,7 +460,7 @@ def _run(args, platform, probe_attempts=None):
     import jax
     device_platform = jax.devices()[0].platform
 
-    print(json.dumps({
+    result = {
         "metric": "pert_step2_svi_cells_per_sec",
         "value": round(cells_per_sec, 1),
         "unit": f"cells/sec ({args.cells}x{args.loci} bins, P={args.P}, "
@@ -485,7 +493,28 @@ def _run(args, platform, probe_attempts=None):
         # a cpu_fallback artifact must be auditable back to its cause
         "probe": probe_attempts,
         "fallback_reason": args.fallback_reason,
-    }))
+    }
+    print(json.dumps(result))
+
+    from scdna_replication_tools_tpu.obs.runlog import (RunLog,
+                                                        telemetry_disabled)
+
+    if not telemetry_disabled(getattr(args, "telemetry", "none")):
+        # one-event run log: the run_start envelope (device topology,
+        # versions) + the bench result, schema-shared with the pipeline
+        # logs so fleet collection / pert_report tooling reads both
+        # the log destination under the name the config digest excludes:
+        # an A/B bench pair differing only in --telemetry must hash as
+        # the same experiment
+        cfg = dict(vars(args))
+        cfg["telemetry_path"] = cfg.pop("telemetry")
+        run_log = RunLog.create(args.telemetry, run_name="bench")
+        with run_log.session(config=cfg, run_name="bench"):
+            run_log.emit("bench_result", metric=result["metric"],
+                         result=result)
+        if run_log.path:
+            print(f"bench: run telemetry written to {run_log.path}",
+                  file=sys.stderr)
 
 
 def main():
@@ -551,6 +580,12 @@ def main():
             argv.append("--skip-baseline")
         if args.remeasure_baseline:
             argv.append("--remeasure-baseline")
+        from scdna_replication_tools_tpu.obs.runlog import telemetry_disabled
+
+        if not telemetry_disabled(getattr(args, "telemetry", "none")):
+            # the failure runs are exactly the ones whose telemetry
+            # matters — forward the flag or the promised JSONL vanishes
+            argv += ["--telemetry", args.telemetry]
         out = subprocess.run(argv, env=env)
         sys.exit(out.returncode)
 

@@ -36,7 +36,11 @@ class scRT:
     same keyword surface; TPU-execution extras: ``backend``, ``num_shards``,
     ``cell_chunk``, ``checkpoint_dir``, ``compile_cache_dir`` (persistent
     XLA compilation cache — 'auto' = repo-local, None disables);
-    ``clustering_method`` selects the
+    ``telemetry_path`` (structured JSONL run log, 'auto' = repo-local
+    ``.pert_runs/``; the written path is surfaced as
+    ``scRT.run_log_path`` — see OBSERVABILITY.md) with
+    ``fit_diag_every`` controlling the in-fit diagnostics sampling
+    stride; ``clustering_method`` selects the
     G1 clone-discovery algorithm when ``clone_col=None`` (``'kmeans'``
     as the reference hardwires, or ``'umap_hdbscan'`` — its optional
     cncluster path), with ``clustering_kwargs`` forwarded to it.
@@ -59,7 +63,8 @@ class scRT:
                  loci_shards=1, cell_chunk=None, checkpoint_dir=None,
                  enum_impl='auto', cn_hmm_self_prob=None,
                  rho_from_rt_prior=False, mirror_rescue=True,
-                 compile_cache_dir='auto',
+                 compile_cache_dir='auto', telemetry_path='auto',
+                 fit_diag_every=25,
                  clustering_method='kmeans', clustering_kwargs=None):
         self.cn_s = cn_s
         self.cn_g1 = cn_g1
@@ -94,6 +99,8 @@ class scRT:
             rho_from_rt_prior=rho_from_rt_prior,
             mirror_rescue=mirror_rescue,
             compile_cache_dir=compile_cache_dir,
+            telemetry_path=telemetry_path,
+            fit_diag_every=fit_diag_every,
         )
 
         self.clone_profiles = None
@@ -103,6 +110,10 @@ class scRT:
         self.phase_report = None         # set by infer(level='pert'):
         # {phase: seconds} wall-clock ledger of the whole run (clone prep,
         # load, per-step build/h2d/trace/compile/fit, decode, packaging)
+        self.run_log_path = None         # set by infer(level='pert'):
+        # the structured JSONL telemetry artifact of the run (None when
+        # telemetry_path disables it); render/compare with
+        # tools/pert_report.py — see OBSERVABILITY.md
 
     # -- dispatch (reference: infer_scRT.py:108-124) ----------------------
 
@@ -152,63 +163,79 @@ class scRT:
     # -- PERT (reference: infer_scRT.py:127-168) --------------------------
 
     def infer_pert_model(self):
+        from scdna_replication_tools_tpu.obs.runlog import RunLog
         from scdna_replication_tools_tpu.utils.profiling import PhaseTimer
 
         c = self.cols
         timer = PhaseTimer()
-        with timer.phase("clone_prep"):
-            self._ensure_clones(c.assign_col)
+        # the facade owns the telemetry session so run_end also covers
+        # decode/packaging (the runner's own session wrapper defers to
+        # an already-open log); run_end is guaranteed even on exception.
+        # Creation is itself a measured phase (path probe + device
+        # queries are real milliseconds the >=95%-coverage invariant
+        # must account for)
+        with timer.phase("telemetry/create"):
+            run_log = RunLog.create(self.config.telemetry_path)
+        self.run_log_path = run_log.path
+        with run_log.session(config=self.config, timer=timer):
+            with timer.phase("clone_prep"):
+                self._ensure_clones(c.assign_col)
 
-            cols = (self.cols if self.clone_col == c.clone_col else
-                    ColumnConfig(**{**self.cols.__dict__,
-                                    'clone_col': self.clone_col}))
+                cols = (self.cols if self.clone_col == c.clone_col else
+                        ColumnConfig(**{**self.cols.__dict__,
+                                        'clone_col': self.clone_col}))
 
-        with timer.phase("load"):
-            s_data, g1_data = build_pert_inputs(self.cn_s, self.cn_g1, cols)
+            with timer.phase("load"):
+                s_data, g1_data = build_pert_inputs(self.cn_s, self.cn_g1,
+                                                    cols)
 
-            # dense clone indices aligned to the data cell order
-            clone_ids = sorted(self.cn_g1[self.clone_col].astype(str)
-                               .unique())
-            clone_map = {cid: i for i, cid in enumerate(clone_ids)}
+                # dense clone indices aligned to the data cell order
+                clone_ids = sorted(self.cn_g1[self.clone_col].astype(str)
+                                   .unique())
+                clone_map = {cid: i for i, cid in enumerate(clone_ids)}
 
-            def _clone_idx(cn, cell_ids):
-                per_cell = cn[[c.cell_col, self.clone_col]] \
-                    .drop_duplicates(c.cell_col) \
-                    .set_index(c.cell_col)[self.clone_col]
-                return np.array([clone_map[str(per_cell[cid])]
-                                 for cid in cell_ids], np.int32)
+                def _clone_idx(cn, cell_ids):
+                    per_cell = cn[[c.cell_col, self.clone_col]] \
+                        .drop_duplicates(c.cell_col) \
+                        .set_index(c.cell_col)[self.clone_col]
+                    return np.array([clone_map[str(per_cell[cid])]
+                                     for cid in cell_ids], np.int32)
 
-            inference = PertInference(
-                s_data, g1_data, self.config,
-                clone_idx_s=_clone_idx(self.cn_s, s_data.cell_ids),
-                clone_idx_g1=_clone_idx(self.cn_g1, g1_data.cell_ids),
-                num_clones=len(clone_ids),
-            )
-        # the runner accumulates its per-step phases into the same ledger
-        inference.phases = timer
-        step1, step2, step3 = inference.run()
-        # surfaced for callers/tools (None unless mirror_rescue ran)
-        self.mirror_rescue_stats = inference.mirror_rescue_stats
+                inference = PertInference(
+                    s_data, g1_data, self.config,
+                    clone_idx_s=_clone_idx(self.cn_s, s_data.cell_ids),
+                    clone_idx_g1=_clone_idx(self.cn_g1, g1_data.cell_ids),
+                    num_clones=len(clone_ids),
+                    run_log=run_log,
+                )
+            # the runner accumulates its per-step phases into the same
+            # ledger
+            inference.phases = timer
+            step1, step2, step3 = inference.run()
+            # surfaced for callers/tools (None unless mirror_rescue ran)
+            self.mirror_rescue_stats = inference.mirror_rescue_stats
 
-        lamb = float(np.asarray(
-            constrained(step1.spec, step1.fit.params, step1.fixed)["lamb"]
-        ).reshape(-1)[0])
+            with timer.phase("finalize"):
+                lamb = float(np.asarray(
+                    constrained(step1.spec, step1.fit.params,
+                                step1.fixed)["lamb"]
+                ).reshape(-1)[0])
 
-        cn_s_out, supp_s_out = package_step_output(
-            self.cn_s, inference._step2_data, step2, lamb,
-            step1.fit.losses, step2.fit.losses, cols,
-            hmm_self_prob=self.config.cn_hmm_self_prob,
-            mirror_rescue_stats=inference.mirror_rescue_stats,
-            timer=timer, phase_prefix="package_s")
-
-        if step3 is not None:
-            cn_g1_out, supp_g1_out = package_step_output(
-                self.cn_g1, inference._step3_data, step3, lamb,
-                step1.fit.losses, step3.fit.losses, cols,
+            cn_s_out, supp_s_out = package_step_output(
+                self.cn_s, inference._step2_data, step2, lamb,
+                step1.fit.losses, step2.fit.losses, cols,
                 hmm_self_prob=self.config.cn_hmm_self_prob,
-                timer=timer, phase_prefix="package_g1")
-        else:
-            cn_g1_out, supp_g1_out = None, None
+                mirror_rescue_stats=inference.mirror_rescue_stats,
+                timer=timer, phase_prefix="package_s")
+
+            if step3 is not None:
+                cn_g1_out, supp_g1_out = package_step_output(
+                    self.cn_g1, inference._step3_data, step3, lamb,
+                    step1.fit.losses, step3.fit.losses, cols,
+                    hmm_self_prob=self.config.cn_hmm_self_prob,
+                    timer=timer, phase_prefix="package_g1")
+            else:
+                cn_g1_out, supp_g1_out = None, None
 
         self.phase_report = timer.report()
         return cn_s_out, supp_s_out, cn_g1_out, supp_g1_out

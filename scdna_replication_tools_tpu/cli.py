@@ -52,6 +52,12 @@ def infer_scrt_main(argv=None):
                         "'auto' (default, repo-local .jax_cache), a path, "
                         "or 'none' to disable "
                         "(PertConfig.compile_cache_dir)")
+    p.add_argument("--telemetry", default="auto",
+                   help="structured JSONL run log: 'auto' (default, a "
+                        "timestamped file under repo-local .pert_runs/), "
+                        "a file/directory path, or 'none' to disable "
+                        "(PertConfig.telemetry_path); render with "
+                        "tools/pert_report.py")
     args = p.parse_args(argv)
 
     from scdna_replication_tools_tpu.api import scRT
@@ -64,11 +70,17 @@ def infer_scrt_main(argv=None):
                 max_iter=args.max_iter, num_shards=args.num_shards,
                 clustering_method=args.clustering_method,
                 mirror_rescue=args.mirror_rescue,
-                compile_cache_dir=args.compile_cache)
+                compile_cache_dir=args.compile_cache,
+                telemetry_path=args.telemetry)
     out_df, supp_df, _, _ = scrt.infer(level=args.level)
 
     out_df.to_csv(args.output, sep="\t", index=False)
     supp_df.to_csv(args.supp_output, sep="\t", index=False)
+    if scrt.run_log_path:
+        from scdna_replication_tools_tpu.utils.profiling import logger
+
+        logger.info("run telemetry written to %s (render with "
+                    "tools/pert_report.py)", scrt.run_log_path)
 
 
 def infer_spf_main(argv=None):

@@ -120,6 +120,22 @@ class PertConfig:
     # jax_compilation_cache_dir (env var, test harness) wins.  See
     # utils.profiling.enable_persistent_compile_cache.
     compile_cache_dir: Optional[str] = "auto"
+    # structured run telemetry (obs/runlog.py): 'auto' (default) writes
+    # one versioned-schema JSONL event log per run under the repo-local
+    # `.pert_runs/` directory (per-user tmp fallback); a path targets a
+    # specific file (or directory, which gets a timestamped file);
+    # None/'none'/'off' disables.  Multi-host: process 0 writes, other
+    # processes no-op.  Render/compare with tools/pert_report.py; event
+    # reference in OBSERVABILITY.md.
+    telemetry_path: Optional[str] = "auto"
+    # in-fit diagnostics sampling stride (infer/svi.py ring buffer):
+    # every K iterations the compiled loop records loss + global
+    # grad/param norms on device (no host sync; last 64 samples kept,
+    # surfaced as FitResult.diagnostics and in the fit_end telemetry
+    # event).  0 disables; the sampled reductions run inside a compiled
+    # conditional, so steady-state iteration cost is unchanged (bench
+    # guard: tests/test_runlog.py pins <5% step-2 fit overhead).
+    fit_diag_every: int = 25
     # optional genome-smoothed CN decode: Viterbi over loci with this
     # self-transition probability — a simplified stand-in inspired by
     # the transition machinery the reference defines but never uses

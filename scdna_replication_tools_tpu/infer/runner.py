@@ -50,6 +50,7 @@ from scdna_replication_tools_tpu.models.pert import (
 )
 from scdna_replication_tools_tpu.ops.gc import gc_features
 from scdna_replication_tools_tpu.ops.stats import guess_times, pearson_matrix
+from scdna_replication_tools_tpu.obs.runlog import RunLog
 from scdna_replication_tools_tpu.ops.transforms import (
     to_positive,
     to_unit_interval,
@@ -143,6 +144,7 @@ class PertInference:
         clone_idx_s: Optional[np.ndarray] = None,
         clone_idx_g1: Optional[np.ndarray] = None,
         num_clones: int = 0,
+        run_log: Optional[RunLog] = None,
     ):
         self.s = s_data
         self.g1 = g1_data
@@ -157,6 +159,12 @@ class PertInference:
         # callers (api.scRT, tools/full_pipeline_bench) can report where
         # the wall-clock actually went
         self.phases = profiling.PhaseTimer()
+        # structured run telemetry (obs/runlog.py): when the caller (the
+        # api facade, a bench tool) owns a session it passes the log in
+        # (run()'s re-entrant session wrapper then defers to it); a
+        # directly-driven runner creates its own from the config
+        self.run_log = run_log if run_log is not None \
+            else RunLog.create(config.telemetry_path)
         # persistent XLA compilation cache (no-op when already configured
         # or disabled): repeated runs skip the per-step-program compiles
         self.compile_cache_dir = profiling.enable_persistent_compile_cache(
@@ -175,6 +183,14 @@ class PertInference:
             self._mesh = make_mesh(loci_shards=ls)
         elif config.num_shards > 1 or ls > 1:
             self._mesh = make_mesh(config.num_shards, loci_shards=ls)
+        if self._mesh is not None:
+            # realized device topology: folded into run_start when the
+            # session is not yet open, a `note` event otherwise
+            self.run_log.add_context(mesh={
+                "axes": {str(k): int(v)
+                         for k, v in self._mesh.shape.items()},
+                "num_devices": int(len(self._mesh.devices.flat)),
+            })
 
     # -- batches ----------------------------------------------------------
 
@@ -338,6 +354,11 @@ class PertInference:
                 num_iters = int(extra.get("meta.num_iters", len(losses)))
                 converged = bool(extra.get("meta.converged", True))
                 nan_abort = bool(extra.get("meta.nan_abort", False))
+                self.run_log.emit(
+                    "checkpoint", action="load", step=step_name,
+                    path=str(cfg.checkpoint_dir), num_iters=num_iters,
+                    completed=bool(converged or nan_abort
+                                   or num_iters >= max_iter))
                 if converged or nan_abort or num_iters >= max_iter:
                     # completed step: restore as-is, no refit
                     fit = FitResult(params=params, losses=losses,
@@ -376,12 +397,18 @@ class PertInference:
                           learning_rate=cfg.learning_rate,
                           b1=cfg.adam_b1, b2=cfg.adam_b2,
                           opt_state0=opt_state0,
-                          losses_prefix=losses_prefix)
+                          losses_prefix=losses_prefix,
+                          diag_every=cfg.fit_diag_every)
         wall = time.perf_counter() - t0
         for key in ("trace", "compile", "fit"):
             self.phases.add(f"{step_name}/{key}", fit.timings.get(key, 0.0))
         profiling.log_step_summary(step_name, fit, wall,
                                    int(batch.reads.shape[0]))
+        self._emit_fit_events(step_name, fit, wall,
+                              int(batch.reads.shape[0]),
+                              prior_iters=(len(losses_prefix)
+                                           if losses_prefix is not None
+                                           else 0))
 
         if cfg.checkpoint_dir:
             with self.phases.phase(f"{step_name}/checkpoint"):
@@ -393,7 +420,66 @@ class PertInference:
                                num_iters=fit.num_iters,
                                converged=fit.converged,
                                nan_abort=fit.nan_abort)
+            self.run_log.emit("checkpoint", action="save", step=step_name,
+                              path=str(cfg.checkpoint_dir),
+                              num_iters=fit.num_iters,
+                              completed=bool(fit.converged or fit.nan_abort
+                                             or fit.num_iters >= max_iter))
         return StepOutput(fit, spec, fixed, batch, wall)
+
+    @staticmethod
+    def _finite(value):
+        """float(value), or None when non-finite — NaN/Infinity are not
+        valid strict (RFC 8259) JSON, and the poisoned entries are
+        exactly the information for a diverged fit."""
+        v = float(value)
+        return v if np.isfinite(v) else None
+
+    def _emit_fit_events(self, step_name: str, fit: FitResult, wall: float,
+                         num_cells: int, prior_iters: int = 0) -> None:
+        """``fit_end`` (always) + ``nan_abort`` (on a poisoned fit, with
+        the loss-trajectory tail — the post-mortem a terminal scroll
+        loses) for one completed step fit.
+
+        ``prior_iters``: iterations restored from a checkpoint — counted
+        in ``iters`` (the fit's total) but NOT in the throughput rates,
+        whose wall covers only the resumed segment."""
+        iters = max(fit.num_iters - prior_iters, 1)
+        diag = None
+        if fit.diagnostics is not None and len(fit.diagnostics["iter"]):
+            # the ring keeps the LAST <=DIAG_RING samples, so on long
+            # fits this is a trailing WINDOW of the trajectory, not its
+            # start — the window bounds ride along so readers (and the
+            # report's grad-norm column) cannot mistake the oldest
+            # surviving sample for the fit's first iteration
+            d = fit.diagnostics
+            diag = {
+                "every": int(d["every"]),
+                "samples": int(len(d["iter"])),
+                "window_start_iter": int(d["iter"][0]),
+                "window_end_iter": int(d["iter"][-1]),
+                "grad_norm_first": self._finite(d["grad_norm"][0]),
+                "grad_norm_last": self._finite(d["grad_norm"][-1]),
+                "grad_norm_max": self._finite(np.max(d["grad_norm"])),
+                "param_norm_last": self._finite(d["param_norm"][-1]),
+            }
+        self.run_log.emit(
+            "fit_end", step=step_name, iters=int(fit.num_iters),
+            resumed_from_iter=(int(prior_iters) if prior_iters else None),
+            final_loss=(float(fit.losses[-1])
+                        if len(fit.losses) and np.isfinite(fit.losses[-1])
+                        else None),
+            converged=bool(fit.converged), nan_abort=bool(fit.nan_abort),
+            wall_seconds=round(wall, 4),
+            iters_per_second=round(iters / max(wall, 1e-9), 2),
+            cells_per_second=round(num_cells * iters / max(wall, 1e-9), 1),
+            num_cells=num_cells,
+            program_cache=fit.timings.get("program_cache"),
+            diagnostics=diag)
+        if fit.nan_abort:
+            tail = [self._finite(v) for v in fit.losses[-20:]]
+            self.run_log.emit("nan_abort", step=step_name,
+                              iters=int(fit.num_iters), loss_tail=tail)
 
     def run_step1(self) -> StepOutput:
         iters = self.config.resolved_iters()
@@ -521,6 +607,7 @@ class PertInference:
         self.mirror_rescue_stats = {"candidates": int(cand.size),
                                     "accepted": 0}
         if cand.size == 0:
+            self._emit_rescue_event()
             return out
         if cand.size > cfg.mirror_max_cells:
             # bound the sub-fit: most boundary-extreme first (mirrored
@@ -576,11 +663,16 @@ class PertInference:
         # betas-prior width the candidates are later SCORED under — a
         # cold logspace init would optimise them against a different
         # width than the acceptance comparison uses) and the incumbent
-        # GC coefficients (basin-independent).  Seeded from the numpy
-        # copies, NOT from orig_sub: fit_map DONATES the params0 buffers,
-        # and orig_sub must stay alive for the acceptance scoring below.
-        params0["beta_stds_raw"] = jnp.asarray(params_np["beta_stds_raw"])
-        params0["betas"] = jnp.asarray(params_np["betas"][cand])
+        # GC coefficients (basin-independent).  The seeds must be
+        # genuinely FRESH buffers (np.array copy before device_put):
+        # fit_map DONATES params0, and jnp.asarray of an already-put
+        # numpy array returns the SAME zero-copy device buffer — donating
+        # it would let the compiled fit recycle memory that orig_sub and
+        # params_np (both read after the fit: acceptance scoring, splice)
+        # still alias, silently corrupting the comparison.
+        params0["beta_stds_raw"] = jnp.asarray(
+            np.array(params_np["beta_stds_raw"]))
+        params0["betas"] = jnp.asarray(np.array(params_np["betas"][cand]))
 
         fit = fit_map(_PertLossFn(spec=spec), params0, (fixed, sub_batch),
                       max_iter=cfg.mirror_max_iter,
@@ -602,6 +694,10 @@ class PertInference:
         profiling.logger.info(
             "mirror rescue: %d boundary-tau candidates, %d accepted "
             "(per-cell log-joint improved)", cand.size, int(accept.sum()))
+        tau_new = np.asarray(to_unit_interval(np.asarray(fit.params
+                                                         ["tau_raw"])))
+        deltas = (tau_new - tau[cand])[accept]
+        self._emit_rescue_event(deltas)
         if not accept.any():
             return out
 
@@ -613,6 +709,24 @@ class PertInference:
         new_params = {k: jnp.asarray(v) for k, v in params_np.items()}
         new_fit = dataclasses.replace(out.fit, params=new_params)
         return dataclasses.replace(out, fit=new_fit)
+
+    def _emit_rescue_event(self, tau_deltas=None) -> None:
+        """Telemetry ``rescue`` event from ``mirror_rescue_stats`` +
+        per-accepted-cell tau deltas (capped at 64 entries — enough to
+        see the mirror flips without bloating the log)."""
+        stats = self.mirror_rescue_stats or {}
+        deltas = (np.asarray(tau_deltas, np.float64)
+                  if tau_deltas is not None else np.zeros(0))
+        self.run_log.emit(
+            "rescue", step="step2",
+            candidates=int(stats.get("candidates", 0)),
+            accepted=int(stats.get("accepted", 0)),
+            capped_to=stats.get("capped_to"),
+            tau_deltas=[self._finite(round(float(d), 4))
+                        for d in deltas[:64]],
+            tau_mean_abs_delta=(
+                self._finite(round(float(np.mean(np.abs(deltas))), 4))
+                if deltas.size else None))
 
     def run_step3(self, step1: StepOutput, step2: StepOutput) -> StepOutput:
         iters = self.config.resolved_iters()
@@ -660,16 +774,25 @@ class PertInference:
     # -- full pipeline ----------------------------------------------------
 
     def run(self):
-        """Run steps 1-3; returns (step1, step2, step3-or-None)."""
-        step1 = self.run_step1()
-        # timed separately from step2/build: at genome scale the CN prior
-        # (g1_composite / pearson_matrix over a (cells, loci, P) tensor)
-        # is its own multi-second stage (step 3's twin is timed inside
-        # step3/build because it happens there)
-        with self.phases.phase("step2/prior"):
-            etas = self.build_etas()
-        step2 = self.run_step2(step1, etas)
-        step3 = self.run_step3(step1, step2) if self.config.run_step3 else None
+        """Run steps 1-3; returns (step1, step2, step3-or-None).
+
+        A directly-driven runner (no api facade) opens its own telemetry
+        session here — ``RunLog.session`` is re-entrant, so when the
+        facade already owns the open log this wrapper is a pass-through
+        and the facade's ``run_end`` (which also covers decode/packaging)
+        is the one that closes the file.
+        """
+        with self.run_log.session(config=self.config, timer=self.phases):
+            step1 = self.run_step1()
+            # timed separately from step2/build: at genome scale the CN
+            # prior (g1_composite / pearson_matrix over a (cells, loci, P)
+            # tensor) is its own multi-second stage (step 3's twin is
+            # timed inside step3/build because it happens there)
+            with self.phases.phase("step2/prior"):
+                etas = self.build_etas()
+            step2 = self.run_step2(step1, etas)
+            step3 = self.run_step3(step1, step2) \
+                if self.config.run_step3 else None
         return step1, step2, step3
 
 

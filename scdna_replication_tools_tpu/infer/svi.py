@@ -32,6 +32,13 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from scdna_replication_tools_tpu.obs import runlog as _runlog
+
+# fixed slot count of the in-fit diagnostics ring buffer: large enough
+# that a converged fit's whole sampled trajectory usually survives, small
+# enough that the carry cost is invisible (64 x 3 f32 = 768 bytes)
+DIAG_RING = 64
+
 
 @dataclasses.dataclass
 class FitResult:
@@ -46,6 +53,11 @@ class FitResult:
     # wall-clock split of this fit's host-side cost: {"trace", "compile",
     # "fit"} seconds plus "program_cache" ("hit" when the in-process AOT
     # cache served the compiled program — trace and compile are then 0)
+    diagnostics: Optional[dict] = None
+    # on-device fit-health samples (``fit_map(diag_every=K)``): arrays
+    # "iter"/"loss"/"grad_norm"/"param_norm" for the last <=DIAG_RING
+    # iterations sampled every K, recorded INSIDE the while_loop carry
+    # (no host sync) and fetched once post-fit; None when disabled
 
 
 def _window_stat(losses, i, win_size):
@@ -57,8 +69,8 @@ def _window_stat(losses, i, win_size):
     return jnp.max(win) - jnp.min(win)
 
 
-# params0 / opt_state0 / losses0 are initial-value pytrees, dead the
-# moment the loop consumes them — donating them lets XLA reuse their
+# params0 / opt_state0 / losses0 / diag0 are initial-value pytrees, dead
+# the moment the loop consumes them — donating them lets XLA reuse their
 # buffers for the loop carry instead of copying on entry (at the
 # 10k-cell scale pi_logits alone is ~2.8 GB; without donation every fit
 # pays that copy in HBM churn and transient footprint).  Checkpoint
@@ -66,23 +78,44 @@ def _window_stat(losses, i, win_size):
 # values, and every caller builds these pytrees fresh per fit (pinned by
 # tests/test_donation.py).
 @functools.partial(jax.jit, static_argnames=("loss_fn", "max_iter", "min_iter",
-                                             "lr", "b1", "b2"),
-                   donate_argnames=("params0", "opt_state0", "losses0"))
-def _run_fit(loss_fn: Callable, params0: dict, opt_state0, losses0,
+                                             "lr", "b1", "b2", "diag_every"),
+                   donate_argnames=("params0", "opt_state0", "losses0",
+                                    "diag0"))
+def _run_fit(loss_fn: Callable, params0: dict, opt_state0, losses0, diag0,
              i0, loss_args: tuple,
              max_iter: int, min_iter: int, rel_tol: float,
-             lr: float, b1: float, b2: float):
+             lr: float, b1: float, b2: float, diag_every: int):
     tx = optax.adam(learning_rate=lr, b1=b1, b2=b2)
 
     value_and_grad = jax.value_and_grad(loss_fn)
 
     def cond(carry):
-        i, _, _, _, done, _, _ = carry
+        i, _, _, _, _, done, _, _ = carry
         return jnp.logical_and(i < max_iter, jnp.logical_not(done))
 
     def body(carry):
-        i, params, opt_state, losses, _, _, _ = carry
+        i, params, opt_state, losses, diag, _, _, _ = carry
         loss, grads = value_and_grad(params, *loss_args)
+
+        if diag_every:
+            # fit-health ring buffer, fully on device: loss + global
+            # grad/param norms every diag_every iterations.  lax.cond (a
+            # true runtime branch — the loop is not vmapped) keeps the
+            # norm reductions off the non-sampled iterations, so the
+            # steady-state step cost is untouched.
+            def _record(d):
+                row = jnp.stack([
+                    loss.astype(jnp.float32),
+                    optax.global_norm(grads).astype(jnp.float32),
+                    optax.global_norm(params).astype(jnp.float32),
+                ])
+                slot = (i // diag_every) % DIAG_RING
+                return jax.lax.dynamic_update_slice(d, row[None, :],
+                                                    (slot, 0))
+
+            diag = jax.lax.cond(i % diag_every == 0, _record,
+                                lambda d: d, diag)
+
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         losses = losses.at[i].set(loss)
@@ -93,13 +126,14 @@ def _run_fit(loss_fn: Callable, params0: dict, opt_state0, losses0,
         loss_diff = _window_stat(losses, i, min(9, max_iter)) / denom
         converged = jnp.logical_and(i >= min_iter, loss_diff < rel_tol)
         done = jnp.logical_or(is_nan, converged)
-        return (i + 1, params, opt_state, losses, done, converged, is_nan)
+        return (i + 1, params, opt_state, losses, diag, done, converged,
+                is_nan)
 
-    init = (jnp.asarray(i0), params0, opt_state0, losses0,
+    init = (jnp.asarray(i0), params0, opt_state0, losses0, diag0,
             jnp.asarray(False), jnp.asarray(False), jnp.asarray(False))
-    i, params, opt_state, losses, _, converged, is_nan = jax.lax.while_loop(
-        cond, body, init)
-    return i, params, opt_state, losses, converged, is_nan
+    (i, params, opt_state, losses, diag, _, converged,
+     is_nan) = jax.lax.while_loop(cond, body, init)
+    return i, params, opt_state, losses, diag, converged, is_nan
 
 
 def make_opt_state(params: dict, learning_rate: float = 0.05,
@@ -144,35 +178,65 @@ def clear_program_cache() -> None:
     _PROGRAM_CACHE.clear()
 
 
+def _key_hash(key) -> str:
+    """Stable-in-process content hash of a program-cache key, for the
+    telemetry ``compile`` events (reprs of specs/treedefs/shardings are
+    deterministic within a process — good enough to correlate events of
+    one run; NOT comparable across processes)."""
+    import hashlib
+
+    return hashlib.sha256(repr(key).encode()).hexdigest()[:12]
+
+
 def _get_compiled(loss_fn, dynamic_args, rel_tol, statics, timings: dict):
     """Compiled _run_fit program for this signature, timed on miss.
 
     ``rel_tol`` is a DYNAMIC scalar (passed by keyword at lowering time,
     so the compiled program is reusable across tolerance values); the
     caller must invoke the result as ``compiled(*dynamic_args,
-    rel_tol=...)`` to match the lowered pytree."""
+    rel_tol=...)`` to match the lowered pytree.
+
+    Every resolution emits a telemetry ``compile`` event to the active
+    RunLog (no-op outside a session): content hash, hit/miss,
+    trace/compile seconds, plus the program's cost_analysis FLOPs and
+    memory_analysis footprint (cached alongside the program so warm runs
+    still report their memory high-water)."""
     try:
         key = (loss_fn, statics, _abstract_sig(dynamic_args))
         hash(key)
     except TypeError:
+        _runlog.current().emit("compile", key_hash="unhashable",
+                               label=type(loss_fn).__name__,
+                               cache="uncacheable")
         return None  # unhashable loss callable/sharding: fall back
     cached = _PROGRAM_CACHE.get(key)
     if cached is not None:
         _PROGRAM_CACHE.move_to_end(key)
         timings["program_cache"] = "hit"
-        return cached
-    max_iter, min_iter, lr, b1, b2 = statics
+        compiled, stats = cached
+        _runlog.current().emit("compile", key_hash=_key_hash(key),
+                               label=type(loss_fn).__name__, cache="hit",
+                               trace_seconds=0.0, compile_seconds=0.0,
+                               **stats)
+        return compiled
+    max_iter, min_iter, lr, b1, b2, diag_every = statics
     t0 = time.perf_counter()
     lowered = _run_fit.lower(loss_fn, *dynamic_args,
                              max_iter=max_iter, min_iter=min_iter,
-                             rel_tol=rel_tol, lr=lr, b1=b1, b2=b2)
+                             rel_tol=rel_tol, lr=lr, b1=b1, b2=b2,
+                             diag_every=diag_every)
     t1 = time.perf_counter()
     compiled = lowered.compile()
     t2 = time.perf_counter()
     timings["trace"] = t1 - t0
     timings["compile"] = t2 - t1
     timings["program_cache"] = "miss"
-    _PROGRAM_CACHE[key] = compiled
+    stats = _runlog.compiled_program_stats(compiled)
+    _runlog.current().emit("compile", key_hash=_key_hash(key),
+                           label=type(loss_fn).__name__, cache="miss",
+                           trace_seconds=round(t1 - t0, 4),
+                           compile_seconds=round(t2 - t1, 4), **stats)
+    _PROGRAM_CACHE[key] = (compiled, stats)
     while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_MAX:
         _PROGRAM_CACHE.popitem(last=False)
     return compiled
@@ -182,6 +246,7 @@ def fit_map(loss_fn: Callable, params0: dict, loss_args: tuple = (),
             max_iter: int = 2000, min_iter: int = 100, rel_tol: float = 1e-6,
             learning_rate: float = 0.05, b1: float = 0.8, b2: float = 0.99,
             opt_state0=None, losses_prefix: Optional[np.ndarray] = None,
+            diag_every: int = 0,
             ) -> FitResult:
     """Fit ``params`` by MAP ascent of ``-loss_fn`` with reference semantics.
 
@@ -202,6 +267,14 @@ def fit_map(loss_fn: Callable, params0: dict, loss_args: tuple = (),
     iteration ``len(losses_prefix)`` with Adam moments intact, so an
     interrupted fit reproduces the uninterrupted trajectory exactly (the
     loop is deterministic given params + opt state + loss history).
+
+    ``diag_every=K`` (0 = off) samples loss + global grad/param norms
+    every K iterations into an on-device ring buffer of ``DIAG_RING``
+    slots — no host sync during the loop, fetched once post-fit and
+    surfaced as ``FitResult.diagnostics`` (the last <=DIAG_RING samples
+    of the run).  The extra reductions run only on sampled iterations
+    (a compiled conditional), so the steady-state iteration cost is
+    unchanged; K is a static of the compiled program.
     """
     if opt_state0 is None:
         params0 = jax.tree_util.tree_map(jnp.asarray, params0)
@@ -223,12 +296,18 @@ def fit_map(loss_fn: Callable, params0: dict, loss_args: tuple = (),
         i0 = min(int(len(losses_prefix)), int(max_iter))
         losses0 = losses0.at[:i0].set(
             jnp.asarray(losses_prefix[:i0], jnp.float32))
+    i0_host = int(i0)
     i0 = jnp.asarray(i0, jnp.int32)
+
+    diag_every = int(diag_every)
+    # shape (0, 3) when disabled: the carry keeps one uniform pytree
+    # structure and the static diag_every branch removes every diag op
+    diag0 = jnp.zeros((DIAG_RING if diag_every else 0, 3), jnp.float32)
 
     rel_tol = float(rel_tol)
     statics = (int(max_iter), int(min_iter),
-               float(learning_rate), float(b1), float(b2))
-    dynamic_args = (params0, opt_state0, losses0, i0, loss_args)
+               float(learning_rate), float(b1), float(b2), diag_every)
+    dynamic_args = (params0, opt_state0, losses0, diag0, i0, loss_args)
     timings: dict = {"trace": 0.0, "compile": 0.0}
     compiled = _get_compiled(loss_fn, dynamic_args, rel_tol, statics,
                              timings)
@@ -241,10 +320,13 @@ def fit_map(loss_fn: Callable, params0: dict, loss_args: tuple = (),
         out = _run_fit(loss_fn, *dynamic_args,
                        max_iter=statics[0], min_iter=statics[1],
                        rel_tol=rel_tol, lr=statics[2], b1=statics[3],
-                       b2=statics[4])
-    i, params, opt_state, losses, converged, is_nan = out
+                       b2=statics[4], diag_every=diag_every)
+    i, params, opt_state, losses, diag, converged, is_nan = out
     n = int(i)
     losses_host = np.asarray(losses)[:n]
+    diagnostics = None
+    if diag_every:
+        diagnostics = _decode_diag(np.asarray(diag), n, i0_host, diag_every)
     timings["fit"] = time.perf_counter() - t0
     return FitResult(
         params=params,
@@ -254,4 +336,28 @@ def fit_map(loss_fn: Callable, params0: dict, loss_args: tuple = (),
         nan_abort=bool(is_nan),
         opt_state=opt_state,
         timings=timings,
+        diagnostics=diagnostics,
     )
+
+
+def _decode_diag(diag: np.ndarray, num_iters: int, i0: int,
+                 diag_every: int) -> dict:
+    """Map ring-buffer slots back to the iterations they sampled.
+
+    Sampled iterations are the multiples of ``diag_every`` in
+    ``[i0, num_iters)`` (a resumed fit samples only its own segment);
+    slot ``(iter // diag_every) % DIAG_RING`` holds each — the last
+    ``DIAG_RING`` samples are distinct slots, older ones were
+    overwritten.
+    """
+    first = -(-i0 // diag_every) * diag_every  # ceil to a multiple
+    sampled = list(range(first, num_iters, diag_every))
+    kept = sampled[-DIAG_RING:]
+    rows = [(it // diag_every) % DIAG_RING for it in kept]
+    return {
+        "every": diag_every,
+        "iter": np.asarray(kept, np.int64),
+        "loss": diag[rows, 0] if kept else np.zeros(0, np.float32),
+        "grad_norm": diag[rows, 1] if kept else np.zeros(0, np.float32),
+        "param_norm": diag[rows, 2] if kept else np.zeros(0, np.float32),
+    }

@@ -1,0 +1,42 @@
+"""Structured run telemetry (observability subsystem).
+
+The reference's only observability is a DEBUG log stream of
+ms-timestamps around every SVI step (reference: pert_model.py:25-33,
+746); PR 2's :class:`~scdna_replication_tools_tpu.utils.profiling.PhaseTimer`
+made the pipeline's *wall time* a measured quantity, but *what happened*
+— loss trajectories, gradient health, compile-cache hits, rescue
+accept/reject, NaN aborts, device memory — lived only in scattered
+logger lines.  This package turns every run into a diffable artifact:
+
+* :class:`~scdna_replication_tools_tpu.obs.runlog.RunLog` — a
+  versioned-schema JSONL event log (``run_start`` .. ``run_end``), one
+  line per event, written by process 0 only, with ``run_end``
+  guaranteed by a context manager even on exception;
+* :mod:`~scdna_replication_tools_tpu.obs.schema` — the checked-in JSON
+  schema (``runlog_schema.json``) plus a stdlib validator, so the event
+  surface is pinned by tests and cannot silently rot;
+* :mod:`~scdna_replication_tools_tpu.obs.summary` — aggregation of a
+  run's events (phase ledger, compile-cache hit rate, memory
+  high-water, per-step fits) shared by ``tools/pert_report.py`` and the
+  bench tools.
+
+See OBSERVABILITY.md at the repo root for the event reference and how
+the JSONL relates to PhaseTimer and ``tools/trace_summary.py``.
+"""
+
+from scdna_replication_tools_tpu.obs.runlog import (  # noqa: F401
+    RunLog,
+    SCHEMA_VERSION,
+    compiled_program_stats,
+    current,
+    resolve_telemetry_path,
+)
+from scdna_replication_tools_tpu.obs.schema import (  # noqa: F401
+    validate_event,
+    validate_run,
+)
+from scdna_replication_tools_tpu.obs.summary import (  # noqa: F401
+    read_events,
+    summarize_events,
+    summarize_run,
+)

@@ -1,0 +1,429 @@
+"""JSONL run log: one structured event stream per pipeline run.
+
+Design (after NumPyro's effect handlers, arXiv:1912.11554, and Pyro's
+Poutine tracing, arXiv:1810.09538: inference becomes debuggable when
+every run emits an inspectable structured trace):
+
+* one run = one append-only JSONL file; every line is one event dict
+  carrying ``event`` (type), ``seq`` (monotonic per-run counter) and
+  ``t`` (seconds since ``run_start``), flushed as written so a killed
+  run leaves a readable prefix;
+* the event vocabulary and per-event required fields are pinned by the
+  checked-in ``runlog_schema.json`` (see :mod:`obs.schema`);
+* ``run_end`` is GUARANTEED by the :meth:`RunLog.session` context
+  manager — on an exception it records ``status='error'`` plus the
+  exception type/message before re-raising;
+* multi-host: only process 0 writes; every other process gets a
+  disabled no-op instance, so instrumented code never branches on rank;
+* emission never raises into the pipeline: a failing write disables the
+  log with one warning (telemetry must not take down a fit);
+* :func:`current` exposes the innermost active RunLog to layers that
+  are not plumbed explicitly (``infer/svi.py`` emits ``compile`` events
+  through it without threading a handle through ``fit_map``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import hashlib
+import itertools
+import json
+import os
+import pathlib
+import time
+from typing import Optional
+
+from scdna_replication_tools_tpu.utils import profiling
+from scdna_replication_tools_tpu.utils.profiling import logger
+
+SCHEMA_VERSION = 1
+
+
+def _json_safe(value):
+    """Best-effort coercion of numpy/jax scalars and arrays for json."""
+    if hasattr(value, "tolist"):          # np.ndarray / np scalar / jax.Array
+        return value.tolist()
+    if hasattr(value, "item"):
+        return value.item()
+    return str(value)
+
+
+def telemetry_disabled(value) -> bool:
+    """True when a ``telemetry_path``-style value spells 'no telemetry'.
+
+    The single authority on the disable vocabulary — callers that need
+    the predicate before building a RunLog (bench.py decides whether to
+    forward ``--telemetry`` across its re-exec) must use this rather
+    than re-listing the sentinels."""
+    return value in (None, "", "none", "off")
+
+
+# an 'auto' directory accumulates one file per run forever if nobody
+# prunes it; keep the newest N so default-on telemetry stays bounded
+# like the compile cache (explicit paths/directories are never pruned —
+# the user owns those)
+AUTO_RETAIN_RUNS = 50
+
+
+def _prune_auto_dir(root: pathlib.Path) -> None:
+    """Best-effort retention cap for the 'auto' run-log directory."""
+    try:
+        logs = sorted(root.glob("*.jsonl"), key=lambda p: p.stat().st_mtime)
+        for stale in logs[:max(0, len(logs) - (AUTO_RETAIN_RUNS - 1))]:
+            stale.unlink()
+    except OSError:  # concurrent runs may race the stat/unlink
+        pass
+
+
+def resolve_telemetry_path(value, run_name: str = "pert") -> Optional[str]:
+    """Resolve ``PertConfig.telemetry_path`` to a JSONL file path or None.
+
+    ``'auto'`` (the default) creates a timestamped file under the
+    repo-local ``.pert_runs/`` directory (falling back to a per-user tmp
+    dir when that location is unwritable, mirroring the compile-cache
+    policy).  An explicit DIRECTORY gets a generated filename inside it;
+    an explicit file path is used verbatim.  ``None``/``''``/``'none'``/
+    ``'off'`` disables telemetry.
+
+    Never raises: an unusable location resolves to None (one warning) —
+    telemetry is default-on, and a read-only mount must degrade to a
+    logless run, not abort the inference it was meant to observe.
+    """
+    if telemetry_disabled(value):
+        return None
+    stamp = time.strftime("%Y%m%d_%H%M%S")
+    # pid disambiguates concurrent processes; the per-process counter
+    # disambiguates runs launched within the same second of one process
+    # (two same-named logs would otherwise truncate each other via the
+    # one-run-one-file "w" open)
+    fname = (f"{run_name}_{stamp}_{os.getpid()}"
+             f"_{next(_RUN_COUNTER)}.jsonl")
+    if value == "auto":
+        root = pathlib.Path(__file__).resolve().parents[2] / ".pert_runs"
+        if not profiling.probe_writable_dir(root):
+            import tempfile
+
+            root = pathlib.Path(tempfile.gettempdir()) \
+                / f"scdna_rt_tpu_runs_{profiling.stable_user()}"
+            if not profiling.probe_writable_dir(root):
+                logger.warning("telemetry disabled: no writable run-log "
+                               "directory (%s)", root)
+                return None
+        _prune_auto_dir(root)
+        return str(root / fname)
+    path = pathlib.Path(value)
+    if path.is_dir() or str(value).endswith(os.sep):
+        if not profiling.probe_writable_dir(path):
+            logger.warning("telemetry disabled: run-log directory %s is "
+                           "not writable", path)
+            return None
+        return str(path / fname)
+    return str(path)
+
+
+_RUN_COUNTER = itertools.count()
+
+
+def _config_digest(config) -> Optional[str]:
+    """Short content hash of the config for run comparison.
+
+    ``telemetry_path`` is excluded: it names where THIS log lands (every
+    run's differs), and the hash's job is "same experiment?" — a
+    cold/warm or A/B pair must hash equal when only the log location
+    moved.  Fields that change behaviour (compile_cache_dir,
+    checkpoint_dir, iteration budgets, ...) stay in.
+    """
+    try:
+        if dataclasses.is_dataclass(config):
+            config = dataclasses.asdict(config)
+        if isinstance(config, dict):
+            config = {k: v for k, v in config.items()
+                      if k != "telemetry_path"}
+        blob = json.dumps(config, sort_keys=True, default=_json_safe)
+        return hashlib.sha256(blob.encode()).hexdigest()[:12]
+    except (TypeError, ValueError):
+        return None
+
+
+def _device_topology() -> dict:
+    """jax device/process topology for ``run_start``; degrades to {} when
+    jax is unavailable (the log layer must not hard-depend on a backend)."""
+    try:
+        import jax
+
+        devices = jax.devices()
+        return {
+            "platform": devices[0].platform,
+            "device_kind": devices[0].device_kind,
+            "num_devices": len(devices),
+            "local_devices": len(jax.local_devices()),
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count(),
+        }
+    except Exception:  # noqa: BLE001 — telemetry is best-effort
+        return {}
+
+
+def compiled_program_stats(compiled) -> dict:
+    """FLOPs + memory footprint of a compiled XLA program, best-effort.
+
+    ``cost_analysis()`` returns a dict (or a one-element list of dicts,
+    depending on jax version); ``memory_analysis()`` a
+    ``CompiledMemoryStats``.  Backends without the analyses yield {}.
+    ``peak_bytes`` estimates the program's device high-water mark as
+    arguments + outputs + temporaries + generated code minus aliased
+    (donated) buffers — the quantity that decides whether a shape fits
+    in HBM.
+    """
+    stats: dict = {}
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        if cost:
+            flops = cost.get("flops")
+            if flops is not None:
+                stats["flops"] = float(flops)
+            ba = cost.get("bytes accessed")
+            if ba is not None:
+                stats["bytes_accessed"] = float(ba)
+    except Exception:  # noqa: BLE001 — optional per backend
+        pass
+    try:
+        mem = compiled.memory_analysis()
+        if mem is not None:
+            parts = {
+                "argument_bytes": getattr(mem, "argument_size_in_bytes", 0),
+                "output_bytes": getattr(mem, "output_size_in_bytes", 0),
+                "temp_bytes": getattr(mem, "temp_size_in_bytes", 0),
+                "generated_code_bytes":
+                    getattr(mem, "generated_code_size_in_bytes", 0),
+                "alias_bytes": getattr(mem, "alias_size_in_bytes", 0),
+            }
+            stats.update({k: int(v) for k, v in parts.items()})
+            stats["peak_bytes"] = int(
+                parts["argument_bytes"] + parts["output_bytes"]
+                + parts["temp_bytes"] + parts["generated_code_bytes"]
+                - parts["alias_bytes"])
+    except Exception:  # noqa: BLE001
+        pass
+    return stats
+
+
+class RunLog:
+    """Append-only JSONL event log for one run (see module docstring).
+
+    A disabled instance (``path=None``) accepts every call as a no-op,
+    so instrumented code never checks for enablement.
+    """
+
+    def __init__(self, path: Optional[str]):
+        self.path = str(path) if path else None
+        self.enabled = path is not None
+        self._fh = None
+        self._seq = 0
+        self._t0: Optional[float] = None
+        self._open = False
+        self._pending_context: dict = {}
+
+    @classmethod
+    def create(cls, telemetry_path, run_name: str = "pert") -> "RunLog":
+        """RunLog from a ``PertConfig.telemetry_path``-style value.
+
+        Multi-host: only process 0 writes; other processes receive a
+        disabled instance (their events would duplicate process 0's —
+        the compiled programs are identical SPMD).  Never raises — any
+        resolution failure degrades to a disabled log with a warning.
+        """
+        try:
+            path = resolve_telemetry_path(telemetry_path, run_name=run_name)
+        except Exception as exc:  # noqa: BLE001 — observability must not
+            # abort the run it observes
+            logger.warning("telemetry disabled: %s", exc)
+            path = None
+        if path is None:
+            return cls(None)
+        try:
+            import jax
+
+            if jax.process_index() != 0:
+                return cls(None)
+        except Exception:  # noqa: BLE001 — no backend: single process
+            pass
+        return cls(path)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def add_context(self, **fields) -> None:
+        """Attach run metadata: folded into ``run_start`` when the run is
+        not yet open, emitted as a ``note`` event afterwards (e.g. the
+        realized mesh shape, known only once the runner builds it)."""
+        if not self.enabled:
+            return
+        if self._open:
+            self.emit("note", **fields)
+        else:
+            self._pending_context.update(fields)
+
+    def open_run(self, config=None, run_name: str = "pert") -> None:
+        if not self.enabled or self._open:
+            return
+        self._t0 = time.perf_counter()
+        self._open = True
+        # a second run on the same instance (e.g. runner.run() re-invoked)
+        # replaces the file via the "w" open below; seq must restart with
+        # it or validate_run's gap-free 0..n-1 line-index contract breaks
+        self._seq = 0
+        payload = {
+            "schema_version": SCHEMA_VERSION,
+            "run_name": run_name,
+            "pid": os.getpid(),
+            "started_unix": round(time.time(), 3),
+            **_device_topology(),
+            **self._pending_context,
+        }
+        try:
+            import jax
+
+            payload["jax_version"] = jax.__version__
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            import numpy
+
+            payload["numpy_version"] = numpy.__version__
+        except Exception:  # noqa: BLE001
+            pass
+        if config is not None:
+            digest = _config_digest(config)
+            if digest:
+                payload["config_hash"] = digest
+            if dataclasses.is_dataclass(config):
+                payload["config"] = dataclasses.asdict(config)
+            elif isinstance(config, dict):
+                payload["config"] = config
+        self._pending_context = {}
+        self.emit("run_start", **payload)
+
+    def close_run(self, status: str = "ok", error=None,
+                  phases: Optional[dict] = None) -> None:
+        # gate on _open alone: a log disabled MID-run (write failure)
+        # still needs its session state reset and its handle closed
+        if not self._open:
+            return
+        payload: dict = {"status": status,
+                         "wall_seconds": round(self._elapsed(), 4),
+                         "events_emitted": self._seq}
+        if error is not None:
+            payload["error"] = {"type": type(error).__name__,
+                                "message": str(error)[:2000]}
+        if phases:
+            payload["phases"] = dict(phases)
+        self.emit("run_end", **payload)
+        self._open = False
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+
+    @contextlib.contextmanager
+    def session(self, config=None, timer=None, run_name: str = "pert"):
+        """Open the run, register as :func:`current`, stream ``timer``'s
+        phases, and guarantee ``run_end`` — even on exception.
+
+        Re-entrant: an already-open log yields immediately without a
+        second ``run_start``/``run_end`` pair (the outermost owner
+        closes), so a runner used through the api facade does not
+        double-log.
+        """
+        if not self.enabled or self._open:
+            yield self
+            return
+        t0 = time.perf_counter()
+        self.open_run(config=config, run_name=run_name)
+        _STACK.append(self)
+        prev_sink = None
+        if timer is not None:
+            prev_sink = getattr(timer, "on_add", None)
+            timer.on_add = self._phase_sink
+            # opening the run (config digest, version/device queries,
+            # the run_start write) is accounted wall — the coverage
+            # invariant holds with telemetry on
+            timer.add("telemetry/open", time.perf_counter() - t0)
+        try:
+            yield self
+        except BaseException as exc:
+            self.close_run(status="error", error=exc,
+                           phases=timer.report() if timer is not None
+                           else None)
+            raise
+        else:
+            self.close_run(status="ok",
+                           phases=timer.report() if timer is not None
+                           else None)
+        finally:
+            if timer is not None:
+                timer.on_add = prev_sink
+            if _STACK and _STACK[-1] is self:
+                _STACK.pop()
+
+    # -- emission ---------------------------------------------------------
+
+    def _elapsed(self) -> float:
+        return 0.0 if self._t0 is None else time.perf_counter() - self._t0
+
+    def _phase_sink(self, name: str, seconds: float) -> None:
+        self.emit("phase", name=name, seconds=round(float(seconds), 6))
+
+    def emit(self, event: str, **payload) -> None:
+        """Append one event line; never raises (disables on I/O error).
+
+        Events outside an open run are DROPPED: a directly-driven step
+        method (no ``run()``/``session`` around it) must not leave a
+        run_start-less orphan file, and an emit after ``close_run``
+        must not reopen — and thereby truncate — the completed
+        artifact (``run_end`` itself is written before ``_open``
+        clears)."""
+        if not self.enabled or not self._open:
+            return
+        record = {"event": event, "seq": self._seq,
+                  "t": round(self._elapsed(), 4), **payload}
+        self._seq += 1
+        try:
+            if self._fh is None:
+                os.makedirs(os.path.dirname(os.path.abspath(self.path)),
+                            exist_ok=True)
+                # "w", not "a": one run = one file (the schema contract
+                # validate_run pins — seq is the line index); re-running
+                # against an explicit path replaces the previous run
+                # instead of silently stacking two streams in one file
+                self._fh = open(self.path, "w")
+            self._fh.write(json.dumps(record, default=_json_safe) + "\n")
+            self._fh.flush()
+        except (OSError, TypeError, ValueError) as exc:
+            self.enabled = False
+            logger.warning("run log disabled: cannot write %s (%s)",
+                           self.path, exc)
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+
+_NULL = RunLog(None)
+_STACK: list = []
+
+
+def current() -> RunLog:
+    """The innermost active RunLog, or a disabled no-op instance.
+
+    The seam for layers without an explicit handle: ``infer/svi.py``
+    emits ``compile`` events through this, so the AOT program cache
+    stays decoupled from the orchestration layer.
+    """
+    return _STACK[-1] if _STACK else _NULL

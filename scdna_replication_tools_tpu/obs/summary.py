@@ -1,0 +1,118 @@
+"""Aggregation of a run log's events into a compact summary dict.
+
+Shared by ``tools/pert_report.py`` (markdown rendering + ``--compare``)
+and the bench tools (``tools/full_pipeline_bench.py`` folds
+``peak_hbm_bytes`` and the compile-cache hit/miss counts into its JSON
+artifact).  Pure stdlib — tools must be runnable without jax.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+from typing import List, Optional
+
+
+def read_events(path) -> List[dict]:
+    """Parse a JSONL run log; skips blank/corrupt lines (a killed run
+    may leave a truncated final line — the readable prefix still
+    summarises)."""
+    events = []
+    for line in pathlib.Path(path).read_text().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            events.append(json.loads(line))
+        except ValueError:
+            continue
+    return events
+
+
+def _of(events: List[dict], kind: str) -> List[dict]:
+    return [ev for ev in events if ev.get("event") == kind]
+
+
+def summarize_events(events: List[dict]) -> dict:
+    """Aggregate one run's events; every section is None/empty-safe so a
+    partial (crashed) log still summarises."""
+    start = next(iter(_of(events, "run_start")), {})
+    end = next(iter(_of(events, "run_end")), None)
+
+    # phase ledger: streamed increments accumulate per name (the same
+    # semantics as PhaseTimer.add); run_end's final report — when
+    # present — is authoritative and identical up to rounding
+    phases: dict = {}
+    for ev in _of(events, "phase"):
+        name = ev.get("name", "?")
+        phases[name] = phases.get(name, 0.0) + float(ev.get("seconds", 0.0))
+    if end and isinstance(end.get("phases"), dict):
+        phases = {k: v for k, v in end["phases"].items()
+                  if k != "total_accounted"}
+
+    compiles = _of(events, "compile")
+    cache_hits = sum(1 for ev in compiles if ev.get("cache") == "hit")
+    cache_misses = sum(1 for ev in compiles if ev.get("cache") == "miss")
+    peak_bytes = [ev["peak_bytes"] for ev in compiles
+                  if isinstance(ev.get("peak_bytes"), (int, float))]
+
+    fits = [{
+        "step": ev.get("step"),
+        "iters": ev.get("iters"),
+        "final_loss": ev.get("final_loss"),
+        "converged": ev.get("converged"),
+        "nan_abort": ev.get("nan_abort"),
+        "wall_seconds": ev.get("wall_seconds"),
+        "iters_per_second": ev.get("iters_per_second"),
+        "program_cache": ev.get("program_cache"),
+        "diagnostics": ev.get("diagnostics"),
+    } for ev in _of(events, "fit_end")]
+
+    return {
+        "run_name": start.get("run_name"),
+        "schema_version": start.get("schema_version"),
+        "config_hash": start.get("config_hash"),
+        "platform": start.get("platform"),
+        "device_kind": start.get("device_kind"),
+        "num_devices": start.get("num_devices"),
+        "jax_version": start.get("jax_version"),
+        "status": end.get("status") if end else "incomplete",
+        "error": end.get("error") if end else None,
+        "wall_seconds": end.get("wall_seconds") if end else None,
+        "num_events": len(events),
+        "phases": phases,
+        "phase_total": round(sum(phases.values()), 4),
+        "fits": fits,
+        "compile": {
+            "programs": len(compiles),
+            "cache_hits": cache_hits,
+            "cache_misses": cache_misses,
+            # over cacheable resolutions only: 'uncacheable' events
+            # (unhashable loss closures) are neither hits nor misses and
+            # would understate the rate
+            "hit_rate": (round(cache_hits / (cache_hits + cache_misses), 4)
+                         if cache_hits + cache_misses else None),
+            "trace_seconds": round(sum(
+                float(ev.get("trace_seconds", 0.0)) for ev in compiles), 4),
+            "compile_seconds": round(sum(
+                float(ev.get("compile_seconds", 0.0))
+                for ev in compiles), 4),
+            "peak_bytes_max": max(peak_bytes) if peak_bytes else None,
+        },
+        "rescues": _of(events, "rescue"),
+        "nan_aborts": _of(events, "nan_abort"),
+        "checkpoints": _of(events, "checkpoint"),
+    }
+
+
+def summarize_run(path) -> Optional[dict]:
+    """Summary dict for a run-log file; None when unreadable/empty."""
+    try:
+        events = read_events(path)
+    except OSError:
+        return None
+    if not events:
+        return None
+    out = summarize_events(events)
+    out["path"] = str(path)
+    return out

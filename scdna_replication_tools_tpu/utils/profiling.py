@@ -37,22 +37,44 @@ class PhaseTimer:
     accumulated (re-entering a name adds to it) and intentionally FLAT:
     callers keep phases non-overlapping so ``report()``'s total is the
     true sum of accounted wall time (the phase-schema smoke test asserts
-    the phases cover >=95% of an end-to-end run).
+    the phases cover >=95% of an end-to-end run).  Overlapping/nested
+    ``phase()`` contexts would double-count wall and silently break that
+    invariant, so the timer detects them and warns ONCE per instance
+    (warn, not raise: a mis-nested phase still yields better data than
+    an aborted run).
+
+    ``on_add`` (optional callable ``(name, seconds)``) observes every
+    accumulation — the seam the telemetry RunLog uses to stream ``phase``
+    events (see ``obs/runlog.py``) without the timer depending on it.
     """
 
     def __init__(self):
         self.phases: dict = {}
+        self.on_add = None
+        self._depth = 0
+        self._overlap_warned = False
 
     @contextlib.contextmanager
     def phase(self, name: str):
+        if self._depth > 0 and not self._overlap_warned:
+            self._overlap_warned = True
+            logger.warning(
+                "PhaseTimer: phase(%r) entered while another phase is "
+                "still open — overlapping phases double-count wall and "
+                "break the >=95%%-coverage invariant; keep phases flat "
+                "(further overlaps will not be re-reported)", name)
+        self._depth += 1
         t0 = time.perf_counter()
         try:
             yield
         finally:
+            self._depth -= 1
             self.add(name, time.perf_counter() - t0)
 
     def add(self, name: str, seconds: float) -> None:
         self.phases[name] = self.phases.get(name, 0.0) + float(seconds)
+        if self.on_add is not None:
+            self.on_add(name, float(seconds))
 
     def total(self) -> float:
         return float(sum(self.phases.values()))
@@ -62,6 +84,39 @@ class PhaseTimer:
         out = {k: round(v, ndigits) for k, v in sorted(self.phases.items())}
         out["total_accounted"] = round(self.total(), ndigits)
         return out
+
+
+def stable_user() -> str:
+    """Portable per-user discriminator for shared-host tmp paths.
+
+    ``os.getuid`` does not exist on Windows; ``getpass.getuser`` falls
+    through env vars to the passwd db and can itself fail (e.g. a
+    container uid with no passwd entry) — the final fallback must be
+    STABLE across runs (never ``os.getpid()``: a per-pid path would
+    give every process a cold cache, defeating persistence entirely).
+    Shared by the compile-cache and telemetry path resolvers.
+    """
+    import getpass
+
+    try:
+        return getpass.getuser()
+    except (KeyError, OSError):
+        return os.environ.get("USER") or "user"
+
+
+def probe_writable_dir(path) -> bool:
+    """mkdir -p + write-probe; True when ``path`` is usable.  Never
+    raises — callers fall back (or disable) instead of aborting runs
+    over an unwritable observability/cache location."""
+    try:
+        path = pathlib.Path(path)
+        path.mkdir(parents=True, exist_ok=True)
+        probe = path / ".write_probe"
+        probe.touch()
+        probe.unlink()
+        return True
+    except OSError:
+        return False
 
 
 def resolve_compile_cache_dir(value, repo_relative: str = ".jax_cache"):
@@ -76,19 +131,13 @@ def resolve_compile_cache_dir(value, repo_relative: str = ".jax_cache"):
     if value in (None, "", "none", "off"):
         return None
     if value == "auto":
-        root = pathlib.Path(__file__).resolve().parents[2]
-        cand = root / repo_relative
-        try:
-            cand.mkdir(parents=True, exist_ok=True)
-            probe = cand / ".write_probe"
-            probe.touch()
-            probe.unlink()
+        cand = pathlib.Path(__file__).resolve().parents[2] / repo_relative
+        if probe_writable_dir(cand):
             return str(cand)
-        except OSError:
-            import tempfile
+        import tempfile
 
-            return os.path.join(tempfile.gettempdir(),
-                                f"scdna_rt_tpu_jax_cache_{os.getuid()}")
+        return os.path.join(tempfile.gettempdir(),
+                            f"scdna_rt_tpu_jax_cache_{stable_user()}")
     return str(value)
 
 

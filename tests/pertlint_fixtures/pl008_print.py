@@ -1,0 +1,31 @@
+# pertlint test fixture: PL008 print-in-library.  Parsed, never imported.
+import logging
+import logging as log_mod
+from logging import basicConfig
+
+logger = logging.getLogger("scdna_replication_tools_tpu")
+
+
+def report(result):
+    print("fit done:", result)  # expect: PL008
+    logger.info("fit done: %s", result)          # package logger: exempt
+    return result
+
+
+def configure():
+    logging.basicConfig(level="INFO")  # expect: PL008
+    log_mod.basicConfig(level="DEBUG")  # expect: PL008
+    basicConfig()  # expect: PL008
+
+
+def shadowed(print):
+    # a locally-bound `print` is the author's own callable, not stdout
+    print("routed through an injected sink")
+    return print
+
+
+def emitter(records):
+    records.print()                     # attribute call: exempt
+    sup = 42
+    print("debug dump", sup)  # pertlint: disable=PL008
+    return sup

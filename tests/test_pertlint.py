@@ -41,6 +41,7 @@ FIXTURES = {
     "PL005": FIXTURE_DIR / "pl005_rng.py",
     "PL006": FIXTURE_DIR / "pl006_jit_in_loop.py",
     "PL007": FIXTURE_DIR / "pl007_donate.py",
+    "PL008": FIXTURE_DIR / "pl008_print.py",
 }
 
 
@@ -182,6 +183,7 @@ def _seed_violation(rule_id):
                   "        g = jax.jit(f)\n    return g\n"),
         "PL007": ("\n@jax.jit\ndef seeded(params0):\n"
                   "    return params0\n"),
+        "PL008": "\ndef seeded(x):\n    print(x)\n    return x\n",
     }[rule_id]
 
 

@@ -61,6 +61,76 @@ def test_huge_enum_tensor_warning(caplog, synthetic_frames):
     assert any("enumeration tensor" in r.message for r in caplog.records)
 
 
+def test_phase_timer_warns_once_on_overlapping_phases(caplog):
+    """Overlapping phase() contexts double-count wall and break the
+    >=95%-coverage invariant — the timer must flag them (once: a hot
+    loop with a mis-nested phase must not spam a warning per call)."""
+    timer = profiling.PhaseTimer()
+    with caplog.at_level(logging.WARNING, "scdna_replication_tools_tpu"):
+        with timer.phase("outer"):
+            with timer.phase("inner"):
+                pass
+            with timer.phase("inner2"):  # second overlap: not re-reported
+                pass
+    overlap = [r for r in caplog.records
+               if "overlapping phases" in r.message]
+    assert len(overlap) == 1
+    # both phases still accumulated (warn, don't drop data)
+    assert set(timer.phases) == {"outer", "inner", "inner2"}
+
+
+def test_phase_timer_sequential_phases_do_not_warn(caplog):
+    timer = profiling.PhaseTimer()
+    with caplog.at_level(logging.WARNING, "scdna_replication_tools_tpu"):
+        with timer.phase("a"):
+            pass
+        with timer.phase("a"):  # re-entering a NAME accumulates, legal
+            pass
+        with timer.phase("b"):
+            pass
+    assert not [r for r in caplog.records
+                if "overlapping phases" in r.message]
+    assert timer.phases["a"] >= 0.0
+
+
+def test_phase_timer_on_add_sink_observes_every_accumulation():
+    timer = profiling.PhaseTimer()
+    seen = []
+    timer.on_add = lambda name, secs: seen.append((name, secs))
+    with timer.phase("x"):
+        pass
+    timer.add("y", 1.5)
+    assert [name for name, _ in seen] == ["x", "y"]
+    assert seen[1][1] == 1.5
+
+
+def test_compile_cache_tmp_fallback_is_user_stable(monkeypatch):
+    """The tmp-dir fallback must be portable (os.getuid does not exist
+    on Windows — getpass.getuser is the cross-platform spelling) and
+    STABLE across processes: a pid-derived component would give every
+    run a cold cache, defeating the persistent cache entirely."""
+    # force the repo-local candidate to be unwritable
+    real_mkdir = os.makedirs
+
+    def deny(path, *a, **k):
+        raise OSError("read-only checkout")
+
+    monkeypatch.setattr("pathlib.Path.mkdir",
+                        lambda self, *a, **k: deny(self))
+    path1 = profiling.resolve_compile_cache_dir("auto")
+    path2 = profiling.resolve_compile_cache_dir("auto")
+    assert path1 == path2, "fallback cache dir must be stable across calls"
+    assert str(os.getpid()) not in os.path.basename(path1)
+    import getpass
+
+    try:
+        user = getpass.getuser()
+    except (KeyError, OSError):
+        user = os.environ.get("USER") or "user"
+    assert path1.endswith(f"scdna_rt_tpu_jax_cache_{user}")
+    assert real_mkdir is os.makedirs  # monkeypatch scope sanity
+
+
 def test_log_step_summary_line(caplog):
     class Fit:
         num_iters = 10

@@ -122,5 +122,85 @@ def test_bench_committed_baseline_covers_both_budgets():
         assert entry["sec_per_iter"] > 0
 
 
+def test_pert_report_renders_committed_r07_artifacts(tmp_path):
+    """The committed cold/warm telemetry pair must stay renderable —
+    single-run report AND --compare — since they are the documented
+    entry point for the run-report workflow (OBSERVABILITY.md)."""
+    report_tool = _load("pert_report_under_test", "tools/pert_report.py")
+    cold = REPO_ROOT / "artifacts" / "RUNLOG_r07_cold_cpu.jsonl"
+    warm = REPO_ROOT / "artifacts" / "RUNLOG_r07_warm_cpu.jsonl"
+    assert cold.exists() and warm.exists()
+
+    single = report_tool.render_report(cold)
+    assert "# PERT run report" in single
+    assert "## Phase waterfall" in single
+    assert "## SVI fits" in single
+    assert "step2" in single
+    assert "## Compiled programs" in single
+    assert "## Mirror rescue" in single
+
+    out = tmp_path / "cmp.md"
+    report_tool.main(["--compare", str(cold), str(warm),
+                      "--out", str(out)])
+    compare = out.read_text()
+    assert "# PERT run comparison" in compare
+    assert "## Phases (B - A)" in compare
+    assert "## Fits (B - A)" in compare
+    # the pair is the SAME experiment with only the log path moved
+    assert "**configs**: identical" in compare
+
+
+def test_pert_report_renders_nan_abort_diagnostics(tmp_path):
+    """A diverged fit stores its non-finite grad/param norms as null in
+    the JSONL (RFC 8259 has no NaN); the fit table must render that run
+    — it is exactly the post-mortem the report exists for."""
+    import json
+
+    report_tool = _load("pert_report_nan_case", "tools/pert_report.py")
+    events = [
+        {"event": "run_start", "seq": 0, "t": 0.0, "schema_version": 1,
+         "run_name": "pert", "pid": 1},
+        {"event": "fit_end", "seq": 1, "t": 1.0, "step": "step2",
+         "iters": 40, "final_loss": None, "converged": False,
+         "nan_abort": True, "wall_seconds": 1.0,
+         "diagnostics": {"every": 25, "samples": 2,
+                         "window_start_iter": 0, "window_end_iter": 25,
+                         "grad_norm_first": 12.5, "grad_norm_last": None,
+                         "grad_norm_max": None, "param_norm_last": None}},
+        {"event": "nan_abort", "seq": 2, "t": 1.1, "step": "step2",
+         "iters": 40, "loss_tail": [1.0, None]},
+        {"event": "run_end", "seq": 3, "t": 1.2, "status": "ok",
+         "wall_seconds": 1.2, "events_emitted": 4},
+    ]
+    path = tmp_path / "nan_run.jsonl"
+    path.write_text("".join(json.dumps(e) + "\n" for e in events))
+
+    from scdna_replication_tools_tpu.obs import validate_run
+    assert validate_run(path) == []
+    report = report_tool.render_report(path)
+    assert "12.5@i0 → nan@i25" in report
+
+
+def test_committed_r07_runlogs_are_schema_valid():
+    from scdna_replication_tools_tpu.obs import validate_run
+
+    for name in ("RUNLOG_r07_cold_cpu.jsonl", "RUNLOG_r07_warm_cpu.jsonl"):
+        errors = validate_run(REPO_ROOT / "artifacts" / name)
+        assert errors == [], f"{name}: {errors[:5]}"
+
+
+def test_full_pipeline_bench_json_r07_obs_fields():
+    """The r07 artifacts carry the telemetry roll-up fields the BENCH
+    rounds consume (peak HBM + program-cache counts)."""
+    for name in ("FULL_PIPELINE_r07_obs_cold_cpu.json",
+                 "FULL_PIPELINE_r07_obs_warm_cpu.json"):
+        data = json.loads(
+            (REPO_ROOT / "artifacts" / name).read_text())
+        assert data["peak_hbm_bytes"] > 0
+        assert data["compile_cache_misses"] >= 0
+        assert data["compile_cache_hits"] >= 0
+        assert data["run_log"].endswith(".jsonl")
+
+
 if __name__ == "__main__":
     sys.exit(0)

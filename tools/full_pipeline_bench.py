@@ -137,7 +137,8 @@ def run(args):
                 num_shards=args.num_shards, loci_shards=args.loci_shards,
                 cell_chunk=args.cell_chunk,
                 mirror_rescue=args.mirror_rescue,
-                compile_cache_dir=args.compile_cache)
+                compile_cache_dir=args.compile_cache,
+                telemetry_path=args.telemetry)
     if args.profile_dir:
         import dataclasses
         scrt.config = dataclasses.replace(scrt.config,
@@ -171,6 +172,17 @@ def run(args):
         v for k, v in phases.items()
         if k.endswith("/fit") or k.endswith("/rescue"))
 
+    # telemetry roll-up: the run's own JSONL is the source of the memory
+    # high-water and the AOT program-cache hit/miss counts (compile
+    # events carry cost_analysis/memory_analysis per program); a
+    # disabled run log leaves the fields null
+    run_summary = None
+    if scrt.run_log_path:
+        from scdna_replication_tools_tpu.obs.summary import summarize_run
+
+        run_summary = summarize_run(scrt.run_log_path)
+    compile_info = (run_summary or {}).get("compile") or {}
+
     dev = jax.devices()[0]
     out = {
         "metric": "pert_full_pipeline_wall_seconds",
@@ -179,6 +191,10 @@ def run(args):
         "phase_coverage_of_wall": round(accounted / max(t_infer, 1e-9), 4),
         "non_fit_wall_seconds": round(non_fit, 2),
         "compile_cache": args.compile_cache,
+        "run_log": scrt.run_log_path,
+        "peak_hbm_bytes": compile_info.get("peak_bytes_max"),
+        "compile_cache_hits": compile_info.get("cache_hits"),
+        "compile_cache_misses": compile_info.get("cache_misses"),
         "unit": f"seconds ({args.cells} S + {args.g1_cells} G1 cells x "
                 f"{num_loci} bins, {args.cn_prior_method}, "
                 f"max_iter={args.max_iter}, incl. compile + priors + "
@@ -257,6 +273,13 @@ def main(argv=None):
                          "(repo-local .jax_cache), a path, or 'none' — "
                          "cold-vs-warm pairs of this flag measure the "
                          "compile-cache win (PertConfig.compile_cache_dir)")
+    ap.add_argument("--telemetry", default="auto",
+                    help="structured JSONL run log: 'auto' (repo-local "
+                         ".pert_runs/), a file/dir path, or 'none' "
+                         "(PertConfig.telemetry_path); its path lands in "
+                         "the JSON as run_log and feeds peak_hbm_bytes + "
+                         "compile-cache hit/miss counts — render with "
+                         "tools/pert_report.py")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--profile-dir", default=None)
     ap.add_argument("--out", default=None)

@@ -1,0 +1,307 @@
+"""Render a RunLog JSONL into a markdown run report; diff two runs.
+
+The regression tool for BENCH/ACCURACY rounds: every telemetry-enabled
+run (``PertConfig.telemetry_path``, default 'auto') leaves one JSONL
+artifact, and this tool turns it into the five tables a perf
+investigation starts from — phase waterfall, per-step fit table,
+compile-cache hit rate, memory high-water, rescue summary:
+
+    python tools/pert_report.py RUN.jsonl [--out report.md]
+    python tools/pert_report.py --compare COLD.jsonl WARM.jsonl
+
+``--compare`` aligns two runs phase by phase and fit by fit (the
+cold/warm compile-cache pair, a before/after of an optimisation, two
+BENCH rounds) and reports deltas — a diffable artifact instead of two
+terminal scrolls.  Event reference: OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from scdna_replication_tools_tpu.obs.summary import (  # noqa: E402
+    summarize_run,
+)
+
+_BAR_WIDTH = 30
+
+
+def _fmt_seconds(v) -> str:
+    return "-" if v is None else f"{v:.2f}s"
+
+
+def _fmt_bytes(v) -> str:
+    if v is None:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(v) < 1024 or unit == "GiB":
+            return f"{v:.1f} {unit}" if unit != "B" else f"{int(v)} B"
+        v /= 1024
+    return f"{v:.1f} GiB"
+
+
+def _header(summary: dict) -> list:
+    lines = [f"# PERT run report — `{pathlib.Path(summary['path']).name}`",
+             ""]
+    status = summary.get("status")
+    badge = {"ok": "OK", "error": "ERROR", "incomplete": "INCOMPLETE "
+             "(no run_end — killed run?)"}.get(status, status)
+    lines.append(f"- **status**: {badge}")
+    if summary.get("error"):
+        err = summary["error"]
+        lines.append(f"- **error**: `{err.get('type')}`: "
+                     f"{err.get('message')}")
+    if summary.get("wall_seconds") is not None:
+        lines.append(f"- **wall**: {summary['wall_seconds']:.2f}s "
+                     f"(phases account for {summary['phase_total']:.2f}s)")
+    plat = summary.get("platform")
+    if plat:
+        lines.append(f"- **device**: {summary.get('num_devices')}x "
+                     f"{summary.get('device_kind')} ({plat}), "
+                     f"jax {summary.get('jax_version')}")
+    if summary.get("config_hash"):
+        lines.append(f"- **config hash**: `{summary['config_hash']}`")
+    lines.append(f"- **events**: {summary.get('num_events')}")
+    lines.append("")
+    return lines
+
+
+def _phase_waterfall(phases: dict) -> list:
+    if not phases:
+        return ["## Phase waterfall", "", "_no phase events_", ""]
+    total = sum(phases.values()) or 1.0
+    lines = ["## Phase waterfall", "",
+             "| phase | seconds | share | |",
+             "|---|---:|---:|---|"]
+    for name, secs in sorted(phases.items(), key=lambda kv: -kv[1]):
+        share = secs / total
+        bar = "#" * round(share * _BAR_WIDTH)
+        lines.append(f"| `{name}` | {secs:.2f} | {share:.1%} | `{bar}` |")
+    lines.append(f"| **total accounted** | **{total:.2f}** | | |")
+    lines.append("")
+    return lines
+
+
+def _fit_table(fits: list) -> list:
+    lines = ["## SVI fits", ""]
+    if not fits:
+        return lines + ["_no fit_end events_", ""]
+    lines += ["| step | iters | final loss | converged | nan | wall | "
+              "iters/s | program cache | grad-norm (sampled window) |",
+              "|---|---:|---:|---|---|---:|---:|---|---|"]
+    for fit in fits:
+        diag = fit.get("diagnostics") or {}
+        gn = "-"
+        if diag.get("samples"):
+            # the ring buffer keeps a trailing window; label each value
+            # with its iteration so a wrapped ring cannot be misread as
+            # the fit's first/overall gradient norms
+            lo = diag.get("window_start_iter")
+            hi = diag.get("window_end_iter")
+            at = (lambda i: f"@i{i}" if i is not None else "")
+            # norms are null in the JSONL when non-finite (RFC 8259 has
+            # no NaN) — exactly the diverged fits this table post-mortems
+            num = (lambda k: "nan" if diag.get(k) is None
+                   else f"{diag[k]:.3g}")
+            gn = (f"{num('grad_norm_first')}{at(lo)} → "
+                  f"{num('grad_norm_last')}{at(hi)} "
+                  f"(win max {num('grad_norm_max')})")
+        loss = fit.get("final_loss")
+        # .get defaults don't fire for keys PRESENT with value None
+        # (summary.py materializes optional fields that way)
+        opt = (lambda k: "-" if fit.get(k) is None else fit[k])
+        lines.append(
+            f"| {fit.get('step')} | {fit.get('iters')} "
+            f"| {'-' if loss is None else f'{loss:.6g}'} "
+            f"| {fit.get('converged')} | {fit.get('nan_abort')} "
+            f"| {_fmt_seconds(fit.get('wall_seconds'))} "
+            f"| {opt('iters_per_second')} "
+            f"| {opt('program_cache')} | {gn} |")
+    lines.append("")
+    return lines
+
+
+def _compile_section(comp: dict) -> list:
+    lines = ["## Compiled programs", ""]
+    if not comp.get("programs"):
+        return lines + ["_no compile events_", ""]
+    hit_rate = comp.get("hit_rate")
+    lines += [
+        f"- **programs resolved**: {comp['programs']} "
+        f"({comp['cache_hits']} hits / {comp['cache_misses']} misses"
+        + (f", hit rate {hit_rate:.0%}" if hit_rate is not None else "")
+        + ")",
+        f"- **trace**: {comp['trace_seconds']:.2f}s, "
+        f"**compile**: {comp['compile_seconds']:.2f}s",
+        f"- **memory high-water (largest program)**: "
+        f"{_fmt_bytes(comp.get('peak_bytes_max'))}",
+        "",
+    ]
+    return lines
+
+
+def _rescue_section(rescues: list) -> list:
+    lines = ["## Mirror rescue", ""]
+    if not rescues:
+        return lines + ["_no rescue events (mirror_rescue off or "
+                        "no step 2)_", ""]
+    for ev in rescues:
+        cand = ev.get("candidates", 0)
+        acc = ev.get("accepted", 0)
+        line = (f"- {ev.get('step')}: {cand} boundary-tau candidate(s), "
+                f"{acc} accepted")
+        if ev.get("capped_to") is not None:
+            line += f" (capped to {ev['capped_to']})"
+        if ev.get("tau_mean_abs_delta") is not None:
+            line += f"; mean |Δtau| {ev['tau_mean_abs_delta']:.3f}"
+        lines.append(line)
+    lines.append("")
+    return lines
+
+
+def _nan_section(aborts: list) -> list:
+    if not aborts:
+        return []
+    lines = ["## NaN aborts", ""]
+    for ev in aborts:
+        tail = ev.get("loss_tail", [])
+        shown = ", ".join("NaN" if v is None else f"{v:.6g}"
+                          for v in tail[-8:])
+        lines.append(f"- **{ev.get('step')}** aborted at iteration "
+                     f"{ev.get('iters')}; loss tail: {shown}")
+    lines.append("")
+    return lines
+
+
+def render_report(path) -> str:
+    summary = summarize_run(path)
+    if summary is None:
+        raise SystemExit(f"pert_report: no readable events in {path}")
+    lines = _header(summary)
+    lines += _phase_waterfall(summary["phases"])
+    lines += _fit_table(summary["fits"])
+    lines += _compile_section(summary["compile"])
+    lines += _rescue_section(summary["rescues"])
+    lines += _nan_section(summary["nan_aborts"])
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# --compare
+# ---------------------------------------------------------------------------
+
+def _delta(a, b) -> str:
+    if a is None or b is None:
+        return "-"
+    d = b - a
+    pct = f" ({d / a:+.0%})" if a else ""
+    return f"{d:+.2f}{pct}"
+
+
+def render_compare(path_a, path_b) -> str:
+    sa, sb = summarize_run(path_a), summarize_run(path_b)
+    for p, s in ((path_a, sa), (path_b, sb)):
+        if s is None:
+            raise SystemExit(f"pert_report: no readable events in {p}")
+    name_a = pathlib.Path(str(path_a)).name
+    name_b = pathlib.Path(str(path_b)).name
+    lines = [f"# PERT run comparison — A=`{name_a}` vs B=`{name_b}`", "",
+             f"- **A**: status {sa['status']}, wall "
+             f"{_fmt_seconds(sa.get('wall_seconds'))}, "
+             f"{sa.get('num_devices')}x {sa.get('device_kind')}",
+             f"- **B**: status {sb['status']}, wall "
+             f"{_fmt_seconds(sb.get('wall_seconds'))}, "
+             f"{sb.get('num_devices')}x {sb.get('device_kind')}"]
+    ha, hb = sa.get("config_hash"), sb.get("config_hash")
+    if ha and hb:
+        note = "identical" if ha == hb else f"DIFFER (`{ha}` vs `{hb}`)"
+        lines.append(f"- **configs**: {note}")
+    wa, wb = sa.get("wall_seconds"), sb.get("wall_seconds")
+    if wa and wb:
+        lines.append(f"- **wall delta (B - A)**: {_delta(wa, wb)}")
+    lines.append("")
+
+    lines += ["## Phases (B - A)", "",
+              "| phase | A (s) | B (s) | delta |",
+              "|---|---:|---:|---:|"]
+    names = sorted(set(sa["phases"]) | set(sb["phases"]),
+                   key=lambda n: -(max(sa["phases"].get(n, 0.0),
+                                       sb["phases"].get(n, 0.0))))
+    for name in names:
+        va = sa["phases"].get(name)
+        vb = sb["phases"].get(name)
+        lines.append(f"| `{name}` "
+                     f"| {'-' if va is None else f'{va:.2f}'} "
+                     f"| {'-' if vb is None else f'{vb:.2f}'} "
+                     f"| {_delta(va, vb)} |")
+    lines.append(f"| **total** | {sa['phase_total']:.2f} "
+                 f"| {sb['phase_total']:.2f} "
+                 f"| {_delta(sa['phase_total'], sb['phase_total'])} |")
+    lines.append("")
+
+    lines += ["## Fits (B - A)", "",
+              "| step | A iters | B iters | A wall | B wall | wall delta "
+              "| A final loss | B final loss |",
+              "|---|---:|---:|---:|---:|---:|---:|---:|"]
+    fits_a = {f.get("step"): f for f in sa["fits"]}
+    fits_b = {f.get("step"): f for f in sb["fits"]}
+    for step in sorted(set(fits_a) | set(fits_b), key=str):
+        fa, fb = fits_a.get(step, {}), fits_b.get(step, {})
+        la, lb = fa.get("final_loss"), fb.get("final_loss")
+        lines.append(
+            f"| {step} | {fa.get('iters', '-')} | {fb.get('iters', '-')} "
+            f"| {_fmt_seconds(fa.get('wall_seconds'))} "
+            f"| {_fmt_seconds(fb.get('wall_seconds'))} "
+            f"| {_delta(fa.get('wall_seconds'), fb.get('wall_seconds'))} "
+            f"| {'-' if la is None else f'{la:.6g}'} "
+            f"| {'-' if lb is None else f'{lb:.6g}'} |")
+    lines.append("")
+
+    ca, cb = sa["compile"], sb["compile"]
+    lines += [
+        "## Compile (B - A)", "",
+        f"- **A**: {ca['cache_hits']}/{ca['programs']} hits, trace+compile "
+        f"{ca['trace_seconds'] + ca['compile_seconds']:.2f}s, peak "
+        f"{_fmt_bytes(ca.get('peak_bytes_max'))}",
+        f"- **B**: {cb['cache_hits']}/{cb['programs']} hits, trace+compile "
+        f"{cb['trace_seconds'] + cb['compile_seconds']:.2f}s, peak "
+        f"{_fmt_bytes(cb.get('peak_bytes_max'))}",
+        f"- **trace+compile delta**: "
+        f"{_delta(ca['trace_seconds'] + ca['compile_seconds'], cb['trace_seconds'] + cb['compile_seconds'])}",
+        "",
+    ]
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Render a RunLog JSONL as markdown, or diff two runs")
+    ap.add_argument("run", nargs="?", help="run log (.jsonl) to render")
+    ap.add_argument("--compare", nargs=2, metavar=("A", "B"),
+                    help="diff two run logs (e.g. a cold/warm "
+                         "compile-cache pair) instead of rendering one")
+    ap.add_argument("--out", default=None,
+                    help="write the markdown here instead of stdout")
+    args = ap.parse_args(argv)
+
+    if args.compare:
+        report = render_compare(*args.compare)
+    elif args.run:
+        report = render_report(args.run)
+    else:
+        ap.print_usage(sys.stderr)
+        raise SystemExit("pert_report: give a run log or --compare A B")
+
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(report + "\n")
+    else:
+        sys.stdout.write(report + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -6,6 +6,7 @@ from tools.pertlint.rules import (  # noqa: F401
     host_sync,
     jit_in_loop,
     partition_spec,
+    print_log,
     rng,
     tracer_branch,
 )

@@ -1,0 +1,144 @@
+"""PL008: bare ``print(...)`` / ``logging.basicConfig(...)`` in library
+modules.
+
+The observability contract (OBSERVABILITY.md) routes everything a run
+wants to say through exactly two channels: the package logger
+(``utils.profiling.logger`` — one namespace the embedding application
+controls) and the structured RunLog (``obs/runlog.py`` — the machine-
+readable record).  A bare ``print`` bypasses both: it cannot be
+filtered, captured or correlated, corrupts tools whose stdout IS the
+artifact (``bench.py`` and the bench tools print exactly one JSON
+line), and vanishes from the JSONL trace a BENCH round diffs.
+``logging.basicConfig`` is worse — library code calling it mutates the
+ROOT logger of the embedding application (handler duplication, format
+hijacking); configuring logging is the application's decision.
+
+Precision contract (what keeps this rule quiet on correct code):
+
+* only the built-in ``print`` NAME fires — a locally-bound ``print``
+  (shadowed by assignment, parameter, or import) is the author's own
+  callable and exempt; attribute calls (``obj.print()``) never match;
+* ``basicConfig`` fires as an attribute call on any alias of the
+  ``logging`` module (``import logging as log`` included) and as the
+  bare name when imported via ``from logging import basicConfig``;
+* the rule is for LIBRARY modules: the lint gate runs it over
+  ``scdna_replication_tools_tpu`` — scripts under ``tools/`` own their
+  stdout and are not gated.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Set
+
+from tools.pertlint.core import Finding, Rule, register
+
+
+def _logging_aliases(tree: ast.Module) -> Set[str]:
+    """Names the ``logging`` module is bound to in this file."""
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "logging":
+                    aliases.add(alias.asname or "logging")
+    return aliases
+
+
+def _basicconfig_names(tree: ast.Module) -> Set[str]:
+    """Names ``logging.basicConfig`` is bound to via from-imports."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module == "logging":
+                for alias in node.names:
+                    if alias.name == "basicConfig":
+                        names.add(alias.asname or "basicConfig")
+    return names
+
+
+def _binds_print(node) -> bool:
+    """Does THIS scope (function params + its Store/import bindings,
+    nested scopes included as an over-approximation) bind ``print``?"""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        a = node.args
+        params = (list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+                  + ([a.vararg] if a.vararg else [])
+                  + ([a.kwarg] if a.kwarg else []))
+        if any(arg.arg == "print" for arg in params):
+            return True
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store) \
+                and sub.id == "print":
+            return True
+        if isinstance(sub, (ast.Import, ast.ImportFrom)):
+            for alias in sub.names:
+                if (alias.asname or alias.name).split(".")[0] == "print":
+                    return True
+    return False
+
+
+def _print_is_shadowed(node: ast.Call, ctx) -> bool:
+    """Walk the enclosing function scopes (plus module scope): the call
+    is the builtin only when no enclosing scope rebinds ``print``."""
+    cursor = node
+    while cursor is not None:
+        if isinstance(cursor, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and _binds_print(cursor):
+            return True
+        cursor = ctx.parents.get(cursor)
+    # module scope: only direct top-level bindings (a rebind inside some
+    # OTHER function must not exempt this call)
+    for stmt in ctx.tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            if stmt.name == "print":
+                return True
+            continue
+        if _binds_print(stmt):
+            return True
+    return False
+
+
+@register
+class PrintInLibrary(Rule):
+    id = "PL008"
+    name = "print-in-library"
+    severity = "error"
+    description = ("bare print(...) / logging.basicConfig(...) in library "
+                   "modules — route output through the package logger or "
+                   "the telemetry RunLog (obs/runlog.py); basicConfig "
+                   "mutates the embedding application's root logger")
+
+    def check(self, ctx) -> Iterable[Finding]:
+        log_aliases = _logging_aliases(ctx.tree)
+        bc_names = _basicconfig_names(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Name):
+                if func.id == "print" \
+                        and not _print_is_shadowed(node, ctx):
+                    yield self.finding(
+                        ctx, node,
+                        "bare print() in a library module; use the "
+                        "package logger (utils.profiling.logger) or emit "
+                        "a RunLog event (obs/runlog.py)")
+                elif func.id in bc_names:
+                    yield self.finding(
+                        ctx, node,
+                        "logging.basicConfig() in a library module "
+                        "mutates the embedding application's root "
+                        "logger; configure handlers in the application, "
+                        "log through the package logger here")
+            elif (isinstance(func, ast.Attribute)
+                  and func.attr == "basicConfig"
+                  and isinstance(func.value, ast.Name)
+                  and func.value.id in log_aliases):
+                yield self.finding(
+                    ctx, node,
+                    "logging.basicConfig() in a library module mutates "
+                    "the embedding application's root logger; configure "
+                    "handlers in the application, log through the "
+                    "package logger here")
