@@ -40,7 +40,11 @@ class scRT:
     ``.pert_runs/``; the written path is surfaced as
     ``scRT.run_log_path`` — see OBSERVABILITY.md) with
     ``fit_diag_every`` controlling the in-fit diagnostics sampling
-    stride; ``clustering_method`` selects the
+    stride; ``qc`` (default True) enables the model-health layer —
+    posterior-confidence maps, convergence doctor, posterior-predictive
+    checks and the :meth:`cell_qc` table, tunable via
+    ``qc_entropy_thresh``/``qc_frac_thresh``/``qc_ppc_replicates``/
+    ``qc_ppc_z``; ``clustering_method`` selects the
     G1 clone-discovery algorithm when ``clone_col=None`` (``'kmeans'``
     as the reference hardwires, or ``'umap_hdbscan'`` — its optional
     cncluster path), with ``clustering_kwargs`` forwarded to it.
@@ -65,6 +69,8 @@ class scRT:
                  rho_from_rt_prior=False, mirror_rescue=True,
                  compile_cache_dir='auto', telemetry_path='auto',
                  fit_diag_every=25,
+                 qc=True, qc_entropy_thresh=0.5, qc_frac_thresh=0.25,
+                 qc_ppc_replicates=8, qc_ppc_z=5.0,
                  clustering_method='kmeans', clustering_kwargs=None):
         self.cn_s = cn_s
         self.cn_g1 = cn_g1
@@ -101,6 +107,9 @@ class scRT:
             compile_cache_dir=compile_cache_dir,
             telemetry_path=telemetry_path,
             fit_diag_every=fit_diag_every,
+            qc=qc, qc_entropy_thresh=qc_entropy_thresh,
+            qc_frac_thresh=qc_frac_thresh,
+            qc_ppc_replicates=qc_ppc_replicates, qc_ppc_z=qc_ppc_z,
         )
 
         self.clone_profiles = None
@@ -114,6 +123,8 @@ class scRT:
         # the structured JSONL telemetry artifact of the run (None when
         # telemetry_path disables it); render/compare with
         # tools/pert_report.py — see OBSERVABILITY.md
+        self._cell_qc_df = None          # set by infer(level='pert') when
+        # qc=True: the per-cell model-health table (scRT.cell_qc())
 
     # -- dispatch (reference: infer_scRT.py:108-124) ----------------------
 
@@ -221,12 +232,21 @@ class scRT:
                                 step1.fixed)["lamb"]
                 ).reshape(-1)[0])
 
+            qc_collect = {} if self.config.qc else None
             cn_s_out, supp_s_out = package_step_output(
                 self.cn_s, inference._step2_data, step2, lamb,
                 step1.fit.losses, step2.fit.losses, cols,
                 hmm_self_prob=self.config.cn_hmm_self_prob,
                 mirror_rescue_stats=inference.mirror_rescue_stats,
-                timer=timer, phase_prefix="package_s")
+                timer=timer, phase_prefix="package_s",
+                qc_collect=qc_collect,
+                qc_entropy_thresh=self.config.qc_entropy_thresh)
+
+            if qc_collect is not None:
+                # the PPC pass + QC table + cell_qc_summary event, inside
+                # the telemetry session so the artifact carries it
+                self._cell_qc_df = inference.build_cell_qc(
+                    step2, inference._step2_data, qc_collect, timer=timer)
 
             if step3 is not None:
                 cn_g1_out, supp_g1_out = package_step_output(
@@ -239,6 +259,24 @@ class scRT:
 
         self.phase_report = timer.report()
         return cn_s_out, supp_s_out, cn_g1_out, supp_g1_out
+
+    def cell_qc(self) -> pd.DataFrame:
+        """Per-cell model-health QC table of the last PERT run.
+
+        One row per S-phase cell: ``model_tau``, posterior-confidence
+        aggregates (``mean_cn_entropy``/``max_cn_entropy``/
+        ``frac_low_conf``/``mean_rep_entropy``), posterior-predictive
+        check statistics (``ppc_deviance``/``ppc_z``), mirror-rescue
+        status, and ``qc_flags`` (comma-joined reasons: ``high_entropy``,
+        ``ppc_outlier``, ``boundary_tau``, ``non_finite``) with
+        ``qc_pass`` their negation.  Thresholds: the ``qc_*``
+        constructor knobs.  See OBSERVABILITY.md ("Model health").
+        """
+        if self._cell_qc_df is None:
+            raise RuntimeError(
+                "cell_qc() needs a completed infer(level='pert') run with "
+                "qc=True (the default) — run infer first, or drop qc=False")
+        return self._cell_qc_df
 
     # -- deterministic levels (implemented in pipeline/, wired in api) ----
 

@@ -58,6 +58,22 @@ def infer_scrt_main(argv=None):
                         "a file/directory path, or 'none' to disable "
                         "(PertConfig.telemetry_path); render with "
                         "tools/pert_report.py")
+    p.add_argument("--qc", action=BooleanOptionalAction, default=True,
+                   help="model-health QC: posterior-confidence maps, "
+                        "convergence doctor, posterior-predictive checks "
+                        "and the per-cell QC table/events (default ON; "
+                        "--no-qc restores the bare pipeline; "
+                        "PertConfig.qc)")
+    p.add_argument("--qc-entropy-thresh", type=float, default=0.5,
+                   help="normalized CN-posterior entropy above which a "
+                        "bin counts as low-confidence "
+                        "(PertConfig.qc_entropy_thresh)")
+    p.add_argument("--qc-ppc-z", type=float, default=5.0,
+                   help="posterior-predictive z-score above which a cell "
+                        "is flagged ppc_outlier (PertConfig.qc_ppc_z)")
+    p.add_argument("--qc-output", default=None,
+                   help="also write the per-cell QC table (scRT.cell_qc()) "
+                        "to this tsv")
     args = p.parse_args(argv)
 
     from scdna_replication_tools_tpu.api import scRT
@@ -71,14 +87,25 @@ def infer_scrt_main(argv=None):
                 clustering_method=args.clustering_method,
                 mirror_rescue=args.mirror_rescue,
                 compile_cache_dir=args.compile_cache,
-                telemetry_path=args.telemetry)
+                telemetry_path=args.telemetry,
+                qc=args.qc, qc_entropy_thresh=args.qc_entropy_thresh,
+                qc_ppc_z=args.qc_ppc_z)
     out_df, supp_df, _, _ = scrt.infer(level=args.level)
 
     out_df.to_csv(args.output, sep="\t", index=False)
     supp_df.to_csv(args.supp_output, sep="\t", index=False)
-    if scrt.run_log_path:
-        from scdna_replication_tools_tpu.utils.profiling import logger
+    from scdna_replication_tools_tpu.utils.profiling import logger
 
+    if args.qc_output:
+        if scrt._cell_qc_df is not None:
+            scrt.cell_qc().to_csv(args.qc_output, sep="\t", index=False)
+            logger.info("per-cell QC table written to %s", args.qc_output)
+        else:
+            logger.warning(
+                "--qc-output %s requested but no QC table was produced "
+                "(QC runs only with --qc on the pert level); nothing "
+                "written", args.qc_output)
+    if scrt.run_log_path:
         logger.info("run telemetry written to %s (render with "
                     "tools/pert_report.py)", scrt.run_log_path)
 

@@ -136,6 +136,36 @@ class PertConfig:
     # conditional, so steady-state iteration cost is unchanged (bench
     # guard: tests/test_runlog.py pins <5% step-2 fit overhead).
     fit_diag_every: int = 25
+    # --- model-health QC (new; no reference counterpart) ---
+    # master switch for the inference-health diagnostics layer: per-cell
+    # posterior-confidence maps (normalized CN/rep posterior entropies
+    # from the decode slabs), the on-device posterior-predictive check,
+    # the scRT.cell_qc() table and the fit_health / cell_qc_summary
+    # telemetry events.  False restores the pre-QC pipeline exactly (no
+    # extra decode planes, no PPC pass, no extra events).
+    qc: bool = True
+    # a bin counts as low-confidence when its normalized CN-posterior
+    # entropy exceeds this ([0, 1]; 1 = the posterior is uniform)
+    qc_entropy_thresh: float = 0.5
+    # a cell is flagged 'high_entropy' when more than this fraction of
+    # its real bins are low-confidence
+    qc_frac_thresh: float = 0.25
+    # replicate datasets drawn per cell by the posterior-predictive
+    # check (models.pert.ppc_discrepancy) — all on device, vmapped
+    qc_ppc_replicates: int = 8
+    # a cell is flagged 'ppc_outlier' when its observed deviance sits
+    # more than this many replicate standard deviations above the
+    # replicate mean
+    qc_ppc_z: float = 5.0
+    # convergence-doctor thresholds (obs/doctor.py): tail window length,
+    # relative drift below which the tail counts as flat, relative
+    # detrended std above which it counts as oscillating, and the
+    # grad_norm last/first ratio below which the gradient counts as
+    # decayed.  All relative to the fit's total loss improvement.
+    doctor_window: int = 16
+    doctor_slope_tol: float = 1e-4
+    doctor_var_tol: float = 1e-3
+    doctor_grad_ratio: float = 0.1
     # optional genome-smoothed CN decode: Viterbi over loci with this
     # self-transition probability — a simplified stand-in inspired by
     # the transition machinery the reference defines but never uses

@@ -47,6 +47,7 @@ from scdna_replication_tools_tpu.models.pert import (
     init_params,
     per_cell_objective,
     pert_loss,
+    ppc_discrepancy,
 )
 from scdna_replication_tools_tpu.ops.gc import gc_features
 from scdna_replication_tools_tpu.ops.stats import guess_times, pearson_matrix
@@ -154,6 +155,9 @@ class PertInference:
         self.num_clones = num_clones
         self.L = s_data.num_libraries
         self.mirror_rescue_stats = None  # filled by _mirror_rescue
+        # per-cell rescue outcome for the QC table: {"candidates": idx
+        # array, "accepted": idx array} into the step-2 cell axis
+        self._rescue_cells = None
         # end-to-end phase ledger: every stage of steps 1-3 (build, h2d,
         # trace, compile, fit, decode, packaging...) accumulates here so
         # callers (api.scRT, tools/full_pipeline_bench) can report where
@@ -398,7 +402,12 @@ class PertInference:
                           b1=cfg.adam_b1, b2=cfg.adam_b2,
                           opt_state0=opt_state0,
                           losses_prefix=losses_prefix,
-                          diag_every=cfg.fit_diag_every)
+                          diag_every=cfg.fit_diag_every,
+                          doctor_thresholds=dict(
+                              window=cfg.doctor_window,
+                              slope_tol=cfg.doctor_slope_tol,
+                              var_tol=cfg.doctor_var_tol,
+                              grad_ratio=cfg.doctor_grad_ratio))
         wall = time.perf_counter() - t0
         for key in ("trace", "compile", "fit"):
             self.phases.add(f"{step_name}/{key}", fit.timings.get(key, 0.0))
@@ -476,6 +485,24 @@ class PertInference:
             num_cells=num_cells,
             program_cache=fit.timings.get("program_cache"),
             diagnostics=diag)
+        if self.config.qc and fit.health is not None:
+            # the convergence doctor's verdict (obs/doctor.py) as its own
+            # event: fit_end records WHAT the loop measured, fit_health
+            # records what it MEANS — queryable without re-deriving the
+            # thresholds from the loss trajectory
+            h = fit.health
+            self.run_log.emit(
+                "fit_health", step=step_name, verdict=h["verdict"],
+                reason=h["reason"],
+                drift=self._finite(h["drift"]) if h["drift"] is not None
+                else None,
+                rel_var=self._finite(h["rel_var"])
+                if h["rel_var"] is not None else None,
+                window=int(h.get("window", 0)),
+                grad_decay=self._finite(h["grad_decay"])
+                if h["grad_decay"] is not None else None,
+                converged=bool(fit.converged),
+                nan_abort=bool(fit.nan_abort))
         if fit.nan_abort:
             tail = [self._finite(v) for v in fit.losses[-20:]]
             self.run_log.emit("nan_abort", step=step_name,
@@ -606,6 +633,8 @@ class PertInference:
         tau, cand = self._mirror_candidates(out, batch)
         self.mirror_rescue_stats = {"candidates": int(cand.size),
                                     "accepted": 0}
+        self._rescue_cells = {"candidates": cand.copy(),
+                              "accepted": np.zeros(0, cand.dtype)}
         if cand.size == 0:
             self._emit_rescue_event()
             return out
@@ -702,6 +731,7 @@ class PertInference:
             return out
 
         keep = cand[accept]
+        self._rescue_cells["accepted"] = keep.copy()
         res_np = {k: np.asarray(v) for k, v in rescued.items()}
         for key in ("tau_raw", "u", "betas"):
             params_np[key][keep] = res_np[key][accept]
@@ -771,6 +801,137 @@ class PertInference:
         self._step3_data = g1
         return out
 
+    # -- per-cell model-health QC -----------------------------------------
+
+    def build_cell_qc(self, out: StepOutput, data: PertData,
+                      qc_stats: dict,
+                      timer: Optional[profiling.PhaseTimer] = None,
+                      step_name: str = "step2",
+                      ) -> pd.DataFrame:
+        """Per-cell QC table for a fitted step + ``cell_qc_summary`` event.
+
+        ``qc_stats`` carries the posterior-entropy aggregates (and tau)
+        the packaging pass already fetched (``package_step_output``'s
+        ``qc_collect``), so the only new device work here is the
+        posterior-predictive check.  Returns a DataFrame with one row
+        per real cell: tau, entropy aggregates, PPC deviance/z-score,
+        mirror-rescue status, boolean QC flags with reasons — the
+        structured answer to "which cells should I not trust?" that the
+        scatter plots the reference relies on cannot give at scale.
+        """
+        cfg = self.config
+        timer = timer or self.phases
+        spec, params, fixed, batch = (out.spec, out.fit.params, out.fixed,
+                                      out.batch)
+        n = int(np.sum(data.cell_mask)) if data.cell_mask is not None \
+            else data.num_cells
+        cell_ids = list(data.cell_ids)[:n]
+
+        with timer.phase("qc/ppc"):
+            key = jax.random.PRNGKey(cfg.seed)
+            # the MAP planes the packaging decode already produced ride
+            # along in qc_stats, so the PPC never re-enumerates the
+            # joint tensor (its replicate draws are the only new device
+            # work); the h2d of two int planes is noise next to that
+            maps = (qc_stats["cn_map"], qc_stats["rep_map"]) \
+                if "cn_map" in qc_stats else None
+            ppc_dev, ppc_z = jax.device_get(ppc_discrepancy(
+                spec, params, fixed, batch, key,
+                num_replicates=cfg.qc_ppc_replicates, maps=maps))
+            ppc_dev = np.asarray(ppc_dev)[:n]
+            ppc_z = np.asarray(ppc_z)[:n]
+
+        with timer.phase("qc/package"):
+            tau = np.asarray(qc_stats["tau"])[:n]
+            mean_ent = np.asarray(qc_stats["mean_cn_entropy"])[:n]
+            max_ent = np.asarray(qc_stats["max_cn_entropy"])[:n]
+            frac_low = np.asarray(qc_stats["frac_low_conf"])[:n]
+            mean_rep = np.asarray(qc_stats["mean_rep_entropy"])[:n]
+
+            rescue_cand = np.zeros(n, bool)
+            rescue_acc = np.zeros(n, bool)
+            if self._rescue_cells is not None:
+                c = self._rescue_cells["candidates"]
+                a = self._rescue_cells["accepted"]
+                rescue_cand[c[c < n]] = True
+                rescue_acc[a[a < n]] = True
+
+            finite = (np.isfinite(tau) & np.isfinite(mean_ent)
+                      & np.isfinite(ppc_z))
+            # NaN comparisons are False, so a poisoned cell lands only in
+            # non_finite — the one flag that subsumes the others
+            flag_arrays = {
+                "high_entropy": frac_low > cfg.qc_frac_thresh,
+                "ppc_outlier": ppc_z > cfg.qc_ppc_z,
+                "boundary_tau": ((tau < cfg.mirror_tau_lo)
+                                 | (tau > cfg.mirror_tau_hi)),
+                "non_finite": ~finite,
+            }
+            # flag strings assembled per FLAG column (4 vectorised
+            # passes), not per cell — a million-cell table must not pay
+            # millions of interpreter iterations here
+            flags = np.full(n, "", object)
+            for name, arr in flag_arrays.items():
+                sep = np.where(flags == "", "", ",")
+                flags = np.where(arr, flags + sep + name, flags)
+            flagged = flags != ""
+
+            df = pd.DataFrame({
+                "cell_id": cell_ids,
+                "model_tau": tau,
+                "mean_cn_entropy": mean_ent,
+                "max_cn_entropy": max_ent,
+                "frac_low_conf": frac_low,
+                "mean_rep_entropy": mean_rep,
+                "ppc_deviance": ppc_dev,
+                "ppc_z": ppc_z,
+                "rescue_candidate": rescue_cand,
+                "rescue_accepted": rescue_acc,
+                # 'qc_flags', not 'flags': pandas reserves .flags as a
+                # DataFrame/Series property, which would shadow
+                # attribute access to the column
+                "qc_flags": flags,
+                "qc_pass": ~flagged,
+            })
+
+            # flagged-cell detail capped at 64 entries (like rescue's
+            # tau_deltas), most-suspect first: PPC outliers by z, then
+            # the rest by low-confidence fraction
+            order = np.argsort(-(np.nan_to_num(ppc_z, nan=np.inf,
+                                               posinf=np.inf)
+                                 + np.nan_to_num(frac_low, nan=1.0)))
+            worst = order[flagged[order]][:64]
+            self.run_log.emit(
+                "cell_qc_summary", step=step_name,
+                num_cells=int(n), num_flagged=int(flagged.sum()),
+                flag_counts={k: int(v.sum())
+                             for k, v in flag_arrays.items() if v.any()},
+                thresholds={
+                    "entropy_thresh": float(cfg.qc_entropy_thresh),
+                    "frac_thresh": float(cfg.qc_frac_thresh),
+                    "ppc_z": float(cfg.qc_ppc_z),
+                    "ppc_replicates": int(cfg.qc_ppc_replicates),
+                },
+                entropy_hist=[int(v) for v in np.histogram(
+                    mean_ent[np.isfinite(mean_ent)], bins=10,
+                    range=(0.0, 1.0))[0]],
+                mean_cn_entropy_mean=self._finite(np.nanmean(mean_ent))
+                if n else None,
+                ppc_z_max=self._finite(np.nanmax(ppc_z)) if n else None,
+                flagged_cells=[{
+                    "cell_id": str(cell_ids[i]),
+                    "reasons": flags[i].split(","),
+                    "tau": self._finite(tau[i]),
+                    "frac_low_conf": self._finite(frac_low[i]),
+                    "ppc_z": self._finite(ppc_z[i]),
+                } for i in worst])
+            profiling.logger.info(
+                "cell QC: %d/%d cells flagged (%s)", int(flagged.sum()), n,
+                ", ".join(f"{k}={int(v.sum())}"
+                          for k, v in flag_arrays.items() if v.any())
+                or "all clean")
+        return df
+
     # -- full pipeline ----------------------------------------------------
 
     def run(self):
@@ -812,6 +973,8 @@ def package_step_output(
     mirror_rescue_stats: Optional[dict] = None,
     timer: Optional[profiling.PhaseTimer] = None,
     phase_prefix: str = "s",
+    qc_collect: Optional[dict] = None,
+    qc_entropy_thresh: float = 0.5,
 ) -> Tuple[pd.DataFrame, pd.DataFrame]:
     """Decode discretes + attach fitted values to the long-form contract.
 
@@ -828,9 +991,21 @@ def package_step_output(
     genome-smoothed Viterbi CN decode (models/hmm.py) with that
     self-transition probability.  ``timer`` (optional) records the
     decode/fetch/package phases under ``{phase_prefix}/...``.
+
+    ``qc_collect`` (a dict, mutated in place) opts in the
+    posterior-confidence pass: the decode slabs additionally return the
+    per-bin normalized CN/rep posterior entropies
+    (``models.pert.entropy_from_joint``), per-cell aggregates (mean/max
+    entropy, fraction of bins above ``qc_entropy_thresh``) are reduced
+    ON DEVICE, everything rides the same one-bulk-fetch, the long
+    output gains a per-bin ``model_cn_entropy`` column, and
+    ``qc_collect`` receives the per-cell aggregate arrays (+ tau) that
+    ``PertInference.build_cell_qc`` turns into the QC table.
     """
     spec, params, fixed, batch = step.spec, step.fit.params, step.fixed, step.batch
     timer = timer or profiling.PhaseTimer()
+    want_entropy = qc_collect is not None
+    ent_planes = None
     with timer.phase(f"{phase_prefix}/decode"):
         if hmm_self_prob is not None:
             from scdna_replication_tools_tpu.models.pert import (
@@ -840,28 +1015,69 @@ def package_step_output(
             restart = jnp.asarray(
                 np.r_[1.0, (chroms[1:] != chroms[:-1]).astype(np.float32)])
             decoded = decode_discrete_hmm(
-                spec, params, fixed, batch, restart, hmm_self_prob)
+                spec, params, fixed, batch, restart, hmm_self_prob,
+                want_entropy=want_entropy)
+            if want_entropy:
+                decoded, ent_planes = decoded[:3], decoded[3:]
         else:
-            decoded = decode_discrete(spec, params, fixed, batch)
+            decoded = decode_discrete(spec, params, fixed, batch,
+                                      want_entropy=want_entropy)
+            if want_entropy:
+                decoded, ent_planes = decoded[:3], decoded[3:]
         c = constrained(spec, params, fixed)
 
     n = int(np.sum(data.cell_mask)) if data.cell_mask is not None \
         else data.num_cells
     cell_ids = list(data.cell_ids)[:n]
 
+    qc_device = None
+    if want_entropy:
+        with timer.phase(f"{phase_prefix}/qc_aggregate"):
+            # per-cell confidence aggregates reduced on device — the
+            # fetch moves (cells,) vectors, not extra (cells, loci)
+            # planes beyond the one entropy map the output carries
+            cn_ent, rep_ent = ent_planes
+            lmask = batch.effective_loci_mask()
+            denom = jnp.maximum(jnp.sum(lmask), 1.0)
+            qc_device = {
+                "mean_cn_entropy":
+                    jnp.sum(cn_ent * lmask[None, :], axis=1) / denom,
+                "max_cn_entropy":
+                    jnp.max(jnp.where(lmask[None, :] > 0, cn_ent, 0.0),
+                            axis=1),
+                "frac_low_conf":
+                    jnp.sum((cn_ent > qc_entropy_thresh) * lmask[None, :],
+                            axis=1) / denom,
+                "mean_rep_entropy":
+                    jnp.sum(rep_ent * lmask[None, :], axis=1) / denom,
+            }
+
     with timer.phase(f"{phase_prefix}/fetch"):
-        # one bulk device->host transfer for every packaged plane
-        (cn_map, rep_map, p_rep), tau, u, rho, a_c = jax.device_get(
-            (decoded, c["tau"], c["u"], c["rho"], c["a"]))
+        # one bulk device->host transfer for every packaged plane; only
+        # the CN entropy map comes down — the rep-entropy plane's sole
+        # consumer is its on-device per-cell aggregate (qc_device)
+        ((cn_map, rep_map, p_rep), tau, u, rho, a_c, cn_ent_host,
+         qc_host) = jax.device_get(
+            (decoded, c["tau"], c["u"], c["rho"], c["a"],
+             ent_planes[0] if want_entropy else None, qc_device))
 
     with timer.phase(f"{phase_prefix}/package"):
         cn_long = cn_long.copy()
         cn_long[cols.chr_col] = cn_long[cols.chr_col].astype(str)
+        per_bin = {"model_cn_state": cn_map[:n],
+                   "model_rep_state": rep_map[:n],
+                   "model_p_rep": p_rep[:n]}
+        if want_entropy:
+            per_bin["model_cn_entropy"] = cn_ent_host[:n]
+            qc_collect.update({k: np.asarray(v) for k, v in qc_host.items()})
+            qc_collect["tau"] = np.asarray(tau)
+            # the full-shape MAP planes, for the PPC pass downstream
+            # (build_cell_qc) — already fetched, no re-decode needed
+            qc_collect["cn_map"] = np.asarray(cn_map)
+            qc_collect["rep_map"] = np.asarray(rep_map)
         out = attach_dense_columns(
             cn_long, cell_ids, data.loci, cols,
-            per_bin={"model_cn_state": cn_map[:n],
-                     "model_rep_state": rep_map[:n],
-                     "model_p_rep": p_rep[:n]},
+            per_bin=per_bin,
             per_cell={"model_tau": tau[:n], "model_u": u[:n]},
             per_locus={"model_rho": rho},
         )

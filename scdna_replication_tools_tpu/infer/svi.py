@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from scdna_replication_tools_tpu.obs import doctor as _doctor
 from scdna_replication_tools_tpu.obs import runlog as _runlog
 
 # fixed slot count of the in-fit diagnostics ring buffer: large enough
@@ -58,6 +59,13 @@ class FitResult:
     # "iter"/"loss"/"grad_norm"/"param_norm" for the last <=DIAG_RING
     # iterations sampled every K, recorded INSIDE the while_loop carry
     # (no host sync) and fetched once post-fit; None when disabled
+    verdict: Optional[str] = None
+    # convergence-doctor class of this fit's loss tail (obs/doctor.py):
+    # converged / plateaued / oscillating / diverging / unknown
+    health: Optional[dict] = None
+    # the full doctor report behind ``verdict``: reason, relative tail
+    # drift/variance, gradient-norm decay — the ``fit_health`` telemetry
+    # event's payload (infer/runner.py emits it)
 
 
 def _window_stat(losses, i, win_size):
@@ -94,6 +102,13 @@ def _run_fit(loss_fn: Callable, params0: dict, opt_state0, losses0, diag0,
         return jnp.logical_and(i < max_iter, jnp.logical_not(done))
 
     def body(carry):
+        # named_scope: groups this region's device time under one label
+        # in jax.profiler traces (tools/trace_summary.py aggregates by
+        # these pipeline-phase scopes)
+        with jax.named_scope("pert/fit_step"):
+            return _body(carry)
+
+    def _body(carry):
         i, params, opt_state, losses, diag, _, _, _ = carry
         loss, grads = value_and_grad(params, *loss_args)
 
@@ -247,6 +262,7 @@ def fit_map(loss_fn: Callable, params0: dict, loss_args: tuple = (),
             learning_rate: float = 0.05, b1: float = 0.8, b2: float = 0.99,
             opt_state0=None, losses_prefix: Optional[np.ndarray] = None,
             diag_every: int = 0,
+            doctor_thresholds: Optional[dict] = None,
             ) -> FitResult:
     """Fit ``params`` by MAP ascent of ``-loss_fn`` with reference semantics.
 
@@ -275,6 +291,14 @@ def fit_map(loss_fn: Callable, params0: dict, loss_args: tuple = (),
     of the run).  The extra reductions run only on sampled iterations
     (a compiled conditional), so the steady-state iteration cost is
     unchanged; K is a static of the compiled program.
+
+    Every fit is also run through the convergence doctor
+    (obs/doctor.py): ``FitResult.verdict`` classifies the loss tail
+    (converged / plateaued / oscillating / diverging / unknown) with the
+    full report on ``FitResult.health``; ``doctor_thresholds`` overrides
+    the doctor's window/slope_tol/var_tol/grad_ratio defaults (the
+    runner passes ``PertConfig``'s).  Host-side on the already-fetched
+    loss history — adds no device work.
     """
     if opt_state0 is None:
         params0 = jax.tree_util.tree_map(jnp.asarray, params0)
@@ -328,6 +352,8 @@ def fit_map(loss_fn: Callable, params0: dict, loss_args: tuple = (),
     if diag_every:
         diagnostics = _decode_diag(np.asarray(diag), n, i0_host, diag_every)
     timings["fit"] = time.perf_counter() - t0
+    health = _diagnose(losses_host, bool(converged), bool(is_nan),
+                       diagnostics, doctor_thresholds)
     return FitResult(
         params=params,
         losses=losses_host,
@@ -337,7 +363,23 @@ def fit_map(loss_fn: Callable, params0: dict, loss_args: tuple = (),
         opt_state=opt_state,
         timings=timings,
         diagnostics=diagnostics,
+        verdict=health["verdict"],
+        health=health,
     )
+
+
+def _diagnose(losses: np.ndarray, converged: bool, nan_abort: bool,
+              diagnostics: Optional[dict],
+              thresholds: Optional[dict]) -> dict:
+    """Convergence-doctor report for one completed fit (host-side)."""
+    kwargs = dict(thresholds or {})
+    grad = diagnostics["grad_norm"] if diagnostics is not None \
+        and len(diagnostics.get("grad_norm", ())) else None
+    return _doctor.diagnose_fit(
+        losses, converged=converged, nan_abort=nan_abort,
+        grad_norm_first=float(grad[0]) if grad is not None else None,
+        grad_norm_last=float(grad[-1]) if grad is not None else None,
+        **kwargs)
 
 
 def _decode_diag(diag: np.ndarray, num_iters: int, i0: int,

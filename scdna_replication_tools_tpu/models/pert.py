@@ -813,26 +813,67 @@ def p_rep_marginal(joint: jnp.ndarray) -> jnp.ndarray:
     return jnp.exp(logsumexp(joint[..., 1], axis=-1) - norm)
 
 
-@functools.partial(jax.jit, static_argnames=("spec",))
+def _plogp_sum(log_p: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """-sum(p * log p) along ``axis`` from log-probabilities, with the
+    0 * -inf corner (a state whose probability underflows to exactly 0)
+    defined as 0 — the measure-theoretic convention."""
+    term = jnp.where(jnp.isfinite(log_p), jnp.exp(log_p) * log_p, 0.0)
+    return -jnp.sum(term, axis=axis)
+
+
+def entropy_from_joint(joint: jnp.ndarray):
+    """(cells, loci) posterior-confidence maps from the joint logits.
+
+    Returns ``(cn_entropy, rep_entropy)``: the Shannon entropies of the
+    per-bin CN and replication-state posterior MARGINALS, each normalized
+    by its maximum (log P and log 2) so both live in [0, 1] — 0 = the
+    posterior is certain, 1 = it is uniform.  This is the per-bin
+    confidence the temperature-0 argmax decode throws away: two cells
+    with identical MAP states can carry entirely different evidence.
+    """
+    P = joint.shape[-2]
+    flat = joint.reshape(joint.shape[:-2] + (P * 2,))
+    log_z = logsumexp(flat, axis=-1)
+    log_post = joint - log_z[..., None, None]
+    cn_ent = _plogp_sum(logsumexp(log_post, axis=-1), axis=-1) \
+        / np.log(P)  # P is a static shape int: host-side log
+    rep_ent = _plogp_sum(logsumexp(log_post, axis=-2), axis=-1) \
+        / np.log(2.0)
+    # clip: f32 rounding can leave the normalized entropy epsilon outside
+    # [0, 1], and downstream thresholds treat the bounds as exact
+    return (jnp.clip(cn_ent, 0.0, 1.0), jnp.clip(rep_ent, 0.0, 1.0))
+
+
+@functools.partial(jax.jit, static_argnames=("spec", "want_entropy"))
 def _decode_slab(spec: PertModelSpec, params: dict, fixed: dict,
-                 batch: PertBatch):
-    """One compiled decode pass: joint logits -> (cn, rep, p_rep).
+                 batch: PertBatch, want_entropy: bool = False):
+    """One compiled decode pass: joint logits -> (cn, rep, p_rep)
+    [+ (cn_entropy, rep_entropy) when ``want_entropy``].
 
     jit-compiled with the (hashable) spec static, so equal-shaped slabs —
     and equal-shaped packaging calls across steps — share one traced and
     compiled program instead of dispatching the whole decode op-by-op
     per slab (the r5 profile showed the eager decode paying host dispatch
-    per primitive at genome scale)."""
-    joint = model_joint_logits(spec, params, fixed, batch)
-    flat = joint.reshape(joint.shape[:-2] + (spec.P * 2,))
-    best = jnp.argmax(flat, axis=-1)
-    return ((best // 2).astype(jnp.int32),
-            (best % 2).astype(jnp.int32),
-            p_rep_marginal(joint))
+    per primitive at genome scale).  The entropy maps reuse the SAME
+    joint tensor the argmax consumes, so the posterior-confidence pass
+    costs one extra logsumexp+reduce over a tensor already in flight —
+    not a second enumeration."""
+    with jax.named_scope("pert/decode"):
+        joint = model_joint_logits(spec, params, fixed, batch)
+        flat = joint.reshape(joint.shape[:-2] + (spec.P * 2,))
+        best = jnp.argmax(flat, axis=-1)
+        out = ((best // 2).astype(jnp.int32),
+               (best % 2).astype(jnp.int32),
+               p_rep_marginal(joint))
+        if want_entropy:
+            with jax.named_scope("pert/qc_entropy"):
+                out = out + entropy_from_joint(joint)
+        return out
 
 
 def decode_discrete(spec: PertModelSpec, params: dict, fixed: dict,
-                    batch: PertBatch, cell_chunk: Optional[int] = None):
+                    batch: PertBatch, cell_chunk: Optional[int] = None,
+                    want_entropy: bool = False):
     """MAP cn/rep per bin + marginal replication probability.
 
     Equivalent to ``infer_discrete(temperature=0)`` on the trained model
@@ -851,25 +892,45 @@ def decode_discrete(spec: PertModelSpec, params: dict, fixed: dict,
     device->host transfer (see ``infer.runner.package_step_output``)
     instead of a per-slab/per-plane trickle.
 
-    Returns (cn_map, rep_map, p_rep) each (cells, loci), on device.
+    Returns (cn_map, rep_map, p_rep) each (cells, loci), on device;
+    ``want_entropy=True`` appends the (cn_entropy, rep_entropy)
+    posterior-confidence maps (see :func:`entropy_from_joint`) computed
+    from the same joint tensor inside the same compiled slab program.
     """
     num_cells = batch.reads.shape[0]
     outs = []
     for idx in _decode_slabs(spec, batch, cell_chunk):
         p, b = (params, batch) if idx is None \
             else slice_cells(params, batch, idx)
-        outs.append(_decode_slab(spec, p, fixed, b))
+        outs.append(_decode_slab(spec, p, fixed, b,
+                                 want_entropy=want_entropy))
     if len(outs) == 1:
         return outs[0]
     # the tail slab clamps its indices to the last cell: trim duplicates
     return tuple(jnp.concatenate([o[i] for o in outs], axis=0)[:num_cells]
-                 for i in range(3))
+                 for i in range(len(outs[0])))
+
+
+def posterior_entropy(spec: PertModelSpec, params: dict, fixed: dict,
+                      batch: PertBatch, cell_chunk: Optional[int] = None):
+    """(cn_entropy, rep_entropy) posterior-confidence maps alone, slabbed.
+
+    For callers that decode by another route (the Viterbi
+    ``decode_discrete_hmm`` path) but still want the per-bin confidence
+    of the fitted posterior.  Shares :func:`_decode_slab`'s compiled
+    program (want_entropy=True) so equal shapes never build a second
+    XLA program just to drop the MAP planes.
+    """
+    out = decode_discrete(spec, params, fixed, batch,
+                          cell_chunk=cell_chunk, want_entropy=True)
+    return out[3], out[4]
 
 
 def decode_discrete_hmm(spec: PertModelSpec, params: dict, fixed: dict,
                         batch: PertBatch, restart: jnp.ndarray,
                         self_prob: float,
-                        cell_chunk: Optional[int] = None):
+                        cell_chunk: Optional[int] = None,
+                        want_entropy: bool = False):
     """Genome-smoothed MAP decode: Viterbi over the CN chain.
 
     Opt-in alternative to :func:`decode_discrete` that couples adjacent
@@ -880,6 +941,11 @@ def decode_discrete_hmm(spec: PertModelSpec, params: dict, fixed: dict,
 
     Cell-slabbed like :func:`decode_discrete` (the Viterbi couples LOCI,
     not cells, so slabbing the cells axis is exact).
+
+    ``want_entropy=True`` appends the (cn_entropy, rep_entropy)
+    posterior-confidence maps computed from the SAME per-slab joint
+    tensor the Viterbi consumes — the confidence pass must not pay a
+    second enumeration of the (cells, loci, P, 2) joint.
     """
     from scdna_replication_tools_tpu.models.hmm import hmm_decode
 
@@ -889,9 +955,106 @@ def decode_discrete_hmm(spec: PertModelSpec, params: dict, fixed: dict,
         p, b = (params, batch) if idx is None \
             else slice_cells(params, batch, idx)
         joint = model_joint_logits(spec, p, fixed, b)
-        outs.append(hmm_decode(joint, restart, self_prob))
+        decoded = hmm_decode(joint, restart, self_prob)
+        if want_entropy:
+            with jax.named_scope("pert/qc_entropy"):
+                decoded = decoded + entropy_from_joint(joint)
+        outs.append(decoded)
     if len(outs) == 1:
         return outs[0]
     # equal-length slabs (tail clamped): trim the duplicate rows
     return tuple(jnp.concatenate([o[i] for o in outs], axis=0)[:num_cells]
                  for i in range(len(outs[0])))
+
+
+# ---------------------------------------------------------------------------
+# posterior-predictive check (model-health QC)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("spec", "num_replicates"))
+def _ppc_slab(spec: PertModelSpec, params: dict, fixed: dict,
+              batch: PertBatch, cn_map: jnp.ndarray, rep_map: jnp.ndarray,
+              key, num_replicates: int):
+    """One compiled PPC pass -> per-cell (observed deviance, z-score).
+
+    Replicate read counts are drawn from the fitted NB observation model
+    at the given MAP discrete states — NB(total_count=delta,
+    probs=lambda) sampled as the Gamma-Poisson mixture y ~
+    Poisson(Gamma(delta) * lambda/(1-lambda)), whose mean
+    delta*lambda/(1-lambda) equals the model's theta (ops/dists.py pins
+    the torch parameterisation).  The MAP states arrive as operands (the
+    decode pass already computed them) so the PPC never re-enumerates
+    the (cells, loci, P, 2) joint tensor.  The per-cell discrepancy is
+    the deviance D = -2 sum_l log NB(y_l | .) over real loci; the
+    z-score standardises the observed deviance against the replicate
+    distribution, vmapped over ``num_replicates`` independent draws
+    entirely on device.
+    """
+    with jax.named_scope("pert/ppc"):
+        cn_map = cn_map.astype(jnp.float32)
+        rep_map = rep_map.astype(jnp.float32)
+
+        c = constrained(spec, params, fixed)
+        lamb, log_lamb, log1m_lamb = _nb_pieces(c)
+        omega = gc_rate(c["betas"], batch.gamma_feats)
+        theta = c["u"][:, None] * omega * cn_map * (1.0 + rep_map)
+        delta = jnp.maximum(theta * (1.0 - lamb) / lamb, 1.0)
+        lmask = batch.effective_loci_mask()
+
+        def deviance(y):
+            return -2.0 * jnp.sum(
+                nb_log_prob(y, delta, log_lamb, log1m_lamb)
+                * lmask[None, :], axis=1)
+
+        def one_replicate(k):
+            kg, kp = jax.random.split(k)
+            rate = jax.random.gamma(kg, delta) * lamb / (1.0 - lamb)
+            y = jax.random.poisson(kp, rate).astype(jnp.float32)
+            return deviance(y)
+
+        obs_dev = deviance(batch.reads)
+        rep_dev = jax.vmap(one_replicate)(
+            jax.random.split(key, num_replicates))
+        z = (obs_dev - jnp.mean(rep_dev, axis=0)) \
+            / jnp.maximum(jnp.std(rep_dev, axis=0), 1e-6)
+        return obs_dev, z
+
+
+def ppc_discrepancy(spec: PertModelSpec, params: dict, fixed: dict,
+                    batch: PertBatch, key, num_replicates: int = 8,
+                    cell_chunk: Optional[int] = None,
+                    maps: Optional[tuple] = None):
+    """Per-cell posterior-predictive discrepancy, cell-slabbed.
+
+    Returns ``(obs_deviance, ppc_z)`` each (cells,), on device.  A large
+    positive ``ppc_z`` means the observed reads fit the cell's own
+    fitted model far worse than the model's replicate draws do — the
+    signature of a corrupted/chimeric cell the posterior point estimates
+    alone cannot reveal.  ``maps`` = (cn_map, rep_map), each (cells,
+    loci), selects the discrete states the replicates are drawn at —
+    pass the planes an earlier decode already produced (the QC path
+    does: ``PertInference.build_cell_qc``) so the joint tensor is never
+    enumerated a second time; None decodes them here (one slabbed
+    decode pass, shared compiled program).  Slabbed like
+    :func:`decode_discrete` (every term is per-cell independent, so
+    slabbing is exact); each slab gets an independent fold of ``key``.
+    """
+    num_cells = batch.reads.shape[0]
+    if maps is None:
+        cn_map, rep_map, _ = decode_discrete(spec, params, fixed, batch,
+                                             cell_chunk=cell_chunk)
+    else:
+        cn_map, rep_map = (jnp.asarray(m) for m in maps)
+    outs = []
+    for si, idx in enumerate(_decode_slabs(spec, batch, cell_chunk)):
+        p, b = (params, batch) if idx is None \
+            else slice_cells(params, batch, idx)
+        cm, rm = (cn_map, rep_map) if idx is None \
+            else (cn_map[idx], rep_map[idx])
+        outs.append(_ppc_slab(spec, p, fixed, b, cm, rm,
+                              jax.random.fold_in(key, si),
+                              num_replicates=int(num_replicates)))
+    if len(outs) == 1:
+        return outs[0]
+    return tuple(jnp.concatenate([o[i] for o in outs], axis=0)[:num_cells]
+                 for i in range(2))

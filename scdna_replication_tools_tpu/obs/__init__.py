@@ -17,13 +17,23 @@ logger lines.  This package turns every run into a diffable artifact:
   surface is pinned by tests and cannot silently rot;
 * :mod:`~scdna_replication_tools_tpu.obs.summary` — aggregation of a
   run's events (phase ledger, compile-cache hit rate, memory
-  high-water, per-step fits) shared by ``tools/pert_report.py`` and the
-  bench tools.
+  high-water, per-step fits, model-health verdicts + cell QC) shared by
+  ``tools/pert_report.py`` and the bench tools;
+* :mod:`~scdna_replication_tools_tpu.obs.doctor` — the convergence
+  doctor: classifies each fit's loss tail (converged / plateaued /
+  oscillating / diverging) plus gradient-norm health, surfaced as
+  ``FitResult.verdict`` and the ``fit_health`` event.
 
 See OBSERVABILITY.md at the repo root for the event reference and how
 the JSONL relates to PhaseTimer and ``tools/trace_summary.py``.
 """
 
+from scdna_replication_tools_tpu.obs.doctor import (  # noqa: F401
+    VERDICTS,
+    classify_loss_tail,
+    diagnose_fit,
+    tail_stats,
+)
 from scdna_replication_tools_tpu.obs.runlog import (  # noqa: F401
     RunLog,
     SCHEMA_VERSION,

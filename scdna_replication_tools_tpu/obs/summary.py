@@ -56,6 +56,19 @@ def summarize_events(events: List[dict]) -> dict:
     peak_bytes = [ev["peak_bytes"] for ev in compiles
                   if isinstance(ev.get("peak_bytes"), (int, float))]
 
+    # model health (schema v2): per-step convergence verdicts + the cell
+    # QC aggregates.  Both default empty on pre-v2 logs — every consumer
+    # (pert_report's "Model health" section) renders a placeholder then.
+    fit_health = [{
+        "step": ev.get("step"),
+        "verdict": ev.get("verdict"),
+        "reason": ev.get("reason"),
+        "drift": ev.get("drift"),
+        "rel_var": ev.get("rel_var"),
+        "window": ev.get("window"),
+        "grad_decay": ev.get("grad_decay"),
+    } for ev in _of(events, "fit_health")]
+
     fits = [{
         "step": ev.get("step"),
         "iters": ev.get("iters"),
@@ -99,6 +112,8 @@ def summarize_events(events: List[dict]) -> dict:
                 for ev in compiles), 4),
             "peak_bytes_max": max(peak_bytes) if peak_bytes else None,
         },
+        "fit_health": fit_health,
+        "cell_qc": _of(events, "cell_qc_summary"),
         "rescues": _of(events, "rescue"),
         "nan_aborts": _of(events, "nan_abort"),
         "checkpoints": _of(events, "checkpoint"),

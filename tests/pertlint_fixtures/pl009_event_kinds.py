@@ -1,0 +1,43 @@
+"""Fixture for PL009 (unknown-runlog-event-kind).
+
+Parsed by the lint tests, never imported.  Lines ending in the expect
+marker must fire; the inline-disable line must land in the suppressed
+list.  Known kinds come from the REAL checked-in schema
+(obs/runlog_schema.json) — 'fit_end', 'compile', 'note' are in its
+enum; 'fit_ended' and 'totally_new_kind' are not.
+"""
+
+
+def known_kinds_are_clean(run_log, _runlog):
+    run_log.emit("fit_end", step="step2", iters=10)     # in the enum: ok
+    run_log.emit("note", msg="contextual")              # in the enum: ok
+    _runlog.current().emit("compile", key_hash="abc",
+                           cache="hit")                 # current() seam: ok
+
+
+def attribute_receiver(self):
+    self.run_log.emit("cell_qc_summary", step="step2",
+                      num_cells=1, num_flagged=0)       # in the enum: ok
+
+
+def unknown_kind_fires(run_log):
+    run_log.emit("fit_ended", step="step2")  # expect: PL009
+    run_log.emit("totally_new_kind", x=1)  # pertlint: disable=PL009
+
+
+def non_runlog_receivers_are_exempt(radio, signal):
+    radio.emit("morse_code")        # not a RunLog: some other emit API
+    signal.emit("clicked")          # ditto (Qt-style signal)
+
+
+def dynamic_kind_is_exempt(run_log, kind):
+    run_log.emit(kind, payload=1)   # non-literal: runtime validator's job
+
+
+class RunLogLike:
+    def emit(self, event, **payload):
+        return (event, payload)
+
+    def open_run(self):
+        # self.emit inside a *Log* class is the canonical lifecycle site
+        self.emit("run_start", pid=0)           # in the enum: ok

@@ -42,6 +42,7 @@ FIXTURES = {
     "PL006": FIXTURE_DIR / "pl006_jit_in_loop.py",
     "PL007": FIXTURE_DIR / "pl007_donate.py",
     "PL008": FIXTURE_DIR / "pl008_print.py",
+    "PL009": FIXTURE_DIR / "pl009_event_kinds.py",
 }
 
 
@@ -184,6 +185,8 @@ def _seed_violation(rule_id):
         "PL007": ("\n@jax.jit\ndef seeded(params0):\n"
                   "    return params0\n"),
         "PL008": "\ndef seeded(x):\n    print(x)\n    return x\n",
+        "PL009": ("\ndef seeded(run_log):\n"
+                  "    run_log.emit('bogus_event_kind')\n"),
     }[rule_id]
 
 

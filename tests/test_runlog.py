@@ -91,7 +91,8 @@ def test_run_event_inventory(telemetry_run):
     assert kinds[0] == "run_start"
     assert kinds[-1] == "run_end"
     start = events[0]
-    assert start["schema_version"] == 1
+    from scdna_replication_tools_tpu.obs import SCHEMA_VERSION
+    assert start["schema_version"] == SCHEMA_VERSION
     assert start["config_hash"]
     assert start["config"]["max_iter"] == 10
     assert start["process_index"] == 0
@@ -229,8 +230,11 @@ def test_fit_end_throughput_excludes_restored_iters(tmp_path):
     from scdna_replication_tools_tpu.infer.runner import PertInference
     from scdna_replication_tools_tpu.infer.svi import FitResult
 
+    from scdna_replication_tools_tpu.config import PertConfig
+
     log = RunLog(str(tmp_path / "resume.jsonl"))
-    host = SimpleNamespace(run_log=log, _finite=PertInference._finite)
+    host = SimpleNamespace(run_log=log, _finite=PertInference._finite,
+                           config=PertConfig())
     fit = FitResult(params={}, losses=np.full(1000, -1.0, np.float32),
                     num_iters=1000, converged=True, nan_abort=False)
     with log.session(config={}):

@@ -202,5 +202,67 @@ def test_full_pipeline_bench_json_r07_obs_fields():
         assert data["run_log"].endswith(".jsonl")
 
 
+def _write_trace(profile_dir, run="2026_01_01_00_00_00",
+                 fname="host.trace.json", gz=False):
+    """A minimal Chrome-trace dump in the jax.profiler layout: two XLA
+    ops inside ``pert/*`` named scopes (one via the event name, one via
+    args metadata — both placements occur across backends) plus one
+    unscoped op."""
+    events = [
+        {"ph": "X", "name": "pert/fit_step/fusion.1", "dur": 3000},
+        {"ph": "X", "name": "loop_convert_fusion",
+         "args": {"long_name": "broadcast(pert/ppc/gamma.2)"},
+         "dur": 2000},
+        # nested named_scope: the innermost scope must win, not fold
+        # into the enclosing pert/decode
+        {"ph": "X", "name": "pert/decode/pert/qc_entropy/reduce.4",
+         "dur": 1500},
+        {"ph": "X", "name": "copy.3", "dur": 1000},
+        {"ph": "M", "name": "process_name"},
+    ]
+    run_dir = profile_dir / "plugins" / "profile" / run
+    run_dir.mkdir(parents=True, exist_ok=True)
+    payload = json.dumps({"traceEvents": events})
+    if gz:
+        import gzip
+        (run_dir / (fname + ".gz")).write_bytes(
+            gzip.compress(payload.encode()))
+    else:
+        (run_dir / fname).write_text(payload)
+
+
+def test_trace_summary_reads_uncompressed_and_groups_scopes(tmp_path):
+    """Satellite contract: plain *.trace.json dumps (some jax
+    versions/backends skip the gzip) are summarised too, and device
+    time is grouped per pert/* named scope whether the scope lands in
+    the event name or in the args metadata."""
+    ts = _load("trace_summary_under_test", "tools/trace_summary.py")
+    _write_trace(tmp_path, gz=False)
+    report = ts.summarise(str(tmp_path))
+    assert "named_scope groups" in report
+    assert "pert/fit_step" in report and "pert/ppc" in report
+    # nested scope attributed to the innermost region
+    assert "pert/qc_entropy" in report
+    # gz and plain dumps coexist without double-listing either run
+    _write_trace(tmp_path, run="2026_01_01_00_00_01", gz=True)
+    report = ts.summarise(str(tmp_path))
+    assert report.count("named_scope groups") == 2
+    # the SAME dump in both forms (gunzip -k) must not double-count
+    _write_trace(tmp_path, run="2026_01_01_00_00_01", gz=False)
+    report = ts.summarise(str(tmp_path))
+    assert report.count("named_scope groups") == 2
+
+
+def test_trace_summary_empty_dir_names_expected_layout(tmp_path):
+    ts = _load("trace_summary_under_test", "tools/trace_summary.py")
+    try:
+        ts.summarise(str(tmp_path))
+    except SystemExit as exc:
+        msg = str(exc)
+        assert "plugins/profile" in msg and "trace.json" in msg
+    else:
+        raise AssertionError("empty profile dir must SystemExit")
+
+
 if __name__ == "__main__":
     sys.exit(0)

@@ -2,9 +2,11 @@
 
 The regression tool for BENCH/ACCURACY rounds: every telemetry-enabled
 run (``PertConfig.telemetry_path``, default 'auto') leaves one JSONL
-artifact, and this tool turns it into the five tables a perf
-investigation starts from — phase waterfall, per-step fit table,
-compile-cache hit rate, memory high-water, rescue summary:
+artifact, and this tool turns it into the tables a perf or
+model-health investigation starts from — phase waterfall, per-step fit
+table, model health (convergence-doctor verdicts, flagged-cell QC,
+entropy histogram), compile-cache hit rate, memory high-water, rescue
+summary:
 
     python tools/pert_report.py RUN.jsonl [--out report.md]
     python tools/pert_report.py --compare COLD.jsonl WARM.jsonl
@@ -144,6 +146,72 @@ def _compile_section(comp: dict) -> list:
     return lines
 
 
+def _model_health_section(fit_health: list, cell_qc: list) -> list:
+    """Convergence-doctor verdicts + per-cell QC aggregates (schema v2
+    ``fit_health`` / ``cell_qc_summary`` events)."""
+    lines = ["## Model health", ""]
+    if not fit_health and not cell_qc:
+        return lines + ["_no model-health events (QC disabled or a "
+                        "pre-v2 run log)_", ""]
+    if fit_health:
+        lines += ["| step | verdict | drift | rel var | grad decay | "
+                  "reason |",
+                  "|---|---|---:|---:|---:|---|"]
+        num = (lambda v: "-" if v is None else f"{v:.3g}")
+        for ev in fit_health:
+            verdict = ev.get("verdict") or "?"
+            mark = "" if verdict == "converged" else " ⚠"
+            lines.append(
+                f"| {ev.get('step')} | **{verdict}**{mark} "
+                f"| {num(ev.get('drift'))} | {num(ev.get('rel_var'))} "
+                f"| {num(ev.get('grad_decay'))} "
+                f"| {ev.get('reason') or '-'} |")
+        lines.append("")
+    for ev in cell_qc:
+        n = ev.get("num_cells") or 0
+        flagged = ev.get("num_flagged") or 0
+        pct = f" ({flagged / n:.1%})" if n else ""
+        counts = ev.get("flag_counts") or {}
+        detail = ", ".join(f"{k}: {v}" for k, v in counts.items())
+        lines.append(f"- **cell QC ({ev.get('step')})**: {n} cells, "
+                     f"{flagged} flagged{pct}"
+                     + (f" — {detail}" if detail else ""))
+        if ev.get("mean_cn_entropy_mean") is not None:
+            lines.append(f"- **mean CN-posterior entropy**: "
+                         f"{ev['mean_cn_entropy_mean']:.4f}"
+                         + (f", max PPC z: {ev['ppc_z_max']:.2f}"
+                            if ev.get("ppc_z_max") is not None else ""))
+        hist = ev.get("entropy_hist") or []
+        if hist and max(hist):
+            lines += ["", "  per-cell mean CN entropy histogram "
+                          "(bins of 0.1 over [0, 1]):", "  ```"]
+            peak = max(hist)
+            for i, count in enumerate(hist):
+                bar = "#" * round(count / peak * _BAR_WIDTH)
+                lines.append(f"  {i / 10:.1f}-{(i + 1) / 10:.1f} "
+                             f"{bar} {count}")
+            lines.append("  ```")
+        flagged_cells = ev.get("flagged_cells") or []
+        if flagged_cells:
+            lines += ["", "| flagged cell | reasons | tau | frac "
+                          "low-conf | PPC z |",
+                      "|---|---|---:|---:|---:|"]
+            num = (lambda v, fmt="{:.3f}": "-" if v is None
+                   else fmt.format(v))
+            for cell in flagged_cells[:10]:
+                lines.append(
+                    f"| `{cell.get('cell_id')}` "
+                    f"| {', '.join(cell.get('reasons') or [])} "
+                    f"| {num(cell.get('tau'))} "
+                    f"| {num(cell.get('frac_low_conf'))} "
+                    f"| {num(cell.get('ppc_z'), '{:.2f}')} |")
+            if len(flagged_cells) > 10:
+                lines.append(f"| _… {len(flagged_cells) - 10} more in "
+                             f"the event_ | | | | |")
+        lines.append("")
+    return lines
+
+
 def _rescue_section(rescues: list) -> list:
     lines = ["## Mirror rescue", ""]
     if not rescues:
@@ -184,6 +252,8 @@ def render_report(path) -> str:
     lines = _header(summary)
     lines += _phase_waterfall(summary["phases"])
     lines += _fit_table(summary["fits"])
+    lines += _model_health_section(summary.get("fit_health", []),
+                                   summary.get("cell_qc", []))
     lines += _compile_section(summary["compile"])
     lines += _rescue_section(summary["rescues"])
     lines += _nan_section(summary["nan_aborts"])

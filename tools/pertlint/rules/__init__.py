@@ -3,6 +3,7 @@
 from tools.pertlint.rules import (  # noqa: F401
     donate,
     dtype_drift,
+    event_kinds,
     host_sync,
     jit_in_loop,
     partition_spec,

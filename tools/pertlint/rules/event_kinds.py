@@ -1,0 +1,121 @@
+"""PL009: RunLog event kinds must exist in the schema's event enum.
+
+The telemetry contract is the checked-in JSON schema
+(``scdna_replication_tools_tpu/obs/runlog_schema.json``): every event a
+run emits is validated against it by tests and by downstream tooling
+(``obs/schema.py``, ``tools/pert_report.py``).  An ``emit("...")`` call
+site whose kind is missing from the schema enum produces events that
+FAIL validation at runtime — but only when a test happens to exercise
+that exact code path, and the RunLog's never-raise discipline means
+production just writes an invalid artifact.  This rule closes the gap
+statically: the AST scan cross-checks every literal event kind at a
+RunLog emit call site against the enum, so adding an event without
+registering it in the schema is a lint error at commit time, not a
+schema violation discovered in an artifact three rounds later.
+
+Precision contract (what keeps this rule quiet on correct code):
+
+* only ``.emit("<literal>", ...)`` attribute calls fire, and only when
+  the receiver is recognisably a RunLog: a name/attribute containing
+  ``log`` (``run_log``, ``self.run_log``, a bare ``log``), the
+  ``current()`` accessor (``_runlog.current().emit(...)`` — the seam
+  ``infer/svi.py`` uses), or ``self`` inside a ``*Log*`` class
+  (``obs/runlog.py``'s own lifecycle emits);
+* non-literal kinds (``emit(kind)``) are skipped — they cannot be
+  checked statically and the runtime validator still covers them;
+* other ``.emit`` APIs (signal buses, Qt, etc.) never match the
+  receiver heuristic.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import json
+import pathlib
+from typing import FrozenSet, Iterable, Optional
+
+from tools.pertlint.core import Finding, Rule, register
+
+_SCHEMA_PATH = (pathlib.Path(__file__).resolve().parents[3]
+                / "scdna_replication_tools_tpu" / "obs"
+                / "runlog_schema.json")
+
+_RECEIVER_HINT = "log"
+
+
+@functools.lru_cache(maxsize=1)
+def schema_event_kinds() -> FrozenSet[str]:
+    """The event enum pinned by the checked-in run-log schema; empty when
+    the schema is unreadable (the rule then stays silent — a missing
+    schema is the schema tests' problem, not a lint crash)."""
+    try:
+        doc = json.loads(_SCHEMA_PATH.read_text())
+        return frozenset(doc["properties"]["event"]["enum"])
+    except (OSError, KeyError, TypeError, ValueError):
+        return frozenset()
+
+
+def _enclosing_log_class(node, ctx) -> bool:
+    """Is ``node`` lexically inside a class whose name contains 'Log'?"""
+    cursor = ctx.parents.get(node)
+    while cursor is not None:
+        if isinstance(cursor, ast.ClassDef) and "Log" in cursor.name:
+            return True
+        cursor = ctx.parents.get(cursor)
+    return False
+
+
+def _is_runlog_receiver(value, node, ctx) -> bool:
+    """Does the ``.emit`` receiver look like a RunLog instance?"""
+    if isinstance(value, ast.Name):
+        if value.id == "self":
+            return _enclosing_log_class(node, ctx)
+        return _RECEIVER_HINT in value.id.lower()
+    if isinstance(value, ast.Attribute):
+        return _RECEIVER_HINT in value.attr.lower()
+    if isinstance(value, ast.Call):
+        func = value.func
+        name = func.id if isinstance(func, ast.Name) else (
+            func.attr if isinstance(func, ast.Attribute) else "")
+        return name == "current"
+    return False
+
+
+@register
+class UnknownRunLogEventKind(Rule):
+    id = "PL009"
+    name = "unknown-runlog-event-kind"
+    severity = "error"
+    description = ("RunLog .emit('<kind>') call site whose event kind is "
+                   "not in the event enum of obs/runlog_schema.json — the "
+                   "emitted events fail schema validation; register the "
+                   "kind (with its payload contract) in the schema first")
+
+    def __init__(self, kinds: Optional[Iterable[str]] = None):
+        # injectable for tests; default = the checked-in schema enum
+        self._kinds = (schema_event_kinds() if kinds is None
+                       else frozenset(kinds))
+
+    def check(self, ctx) -> Iterable[Finding]:
+        if not self._kinds:
+            return
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "emit"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            if not _is_runlog_receiver(node.func.value, node, ctx):
+                continue
+            kind = node.args[0].value
+            if kind not in self._kinds:
+                yield self.finding(
+                    ctx, node,
+                    f"RunLog event kind {kind!r} is not in the event enum "
+                    f"of obs/runlog_schema.json — emitted events will "
+                    f"fail schema validation; add the kind and its "
+                    f"payload contract to the schema (and bump "
+                    f"SCHEMA_VERSION if the vocabulary changes meaning)")

@@ -7,10 +7,18 @@ the raw dumps are tens of MB, so artifacts commit this summary instead
 
     python tools/trace_summary.py <profile_dir> [--top 12] [--out FILE]
 
-For each ``plugins/profile/<run>/*.trace.json.gz`` the report lists the
-top ops by total self-duration, with the profiler's own bookkeeping
-frames (wrapper/asarray/fit_map wrappers) filtered out so the XLA
-fusions the device actually ran lead the list.
+For each ``plugins/profile/<run>/*.trace.json.gz`` (or uncompressed
+``*.trace.json`` — some jax versions/backends skip the gzip) the report
+lists the top ops by total self-duration, with the profiler's own
+bookkeeping frames (wrapper/asarray/fit_map wrappers) filtered out so
+the XLA fusions the device actually ran lead the list.
+
+When the traced programs carry ``jax.named_scope`` annotations (the hot
+fit/decode/QC regions are wrapped in ``pert/<phase>`` scopes —
+``infer/svi.py``, ``models/pert.py``), the report additionally groups
+total time by pipeline-phase scope, answering "how much device time
+went to the fit step vs the decode vs the QC pass" without reading
+op-by-op output.
 """
 
 from __future__ import annotations
@@ -21,6 +29,7 @@ import glob
 import gzip
 import json
 import os
+import re
 import sys
 
 _SKIP = ("wrapper", "np.asarray", "_value", "__int__",
@@ -29,23 +38,83 @@ _SKIP = ("wrapper", "np.asarray", "_value", "__int__",
          "compile_or_get_cached", "_cached_compilation", "from_hlo",
          "_compile_and_write_cache", "backend_compile")
 
+# a named_scope label as it appears embedded in XLA op names / trace
+# metadata: the scope prefix up to (not including) the next '/'.  Nested
+# scopes concatenate ("pert/decode/pert/qc_entropy/..."), so matching
+# code must take the LAST occurrence — the innermost scope.
+_SCOPE_RE = re.compile(r"pert/[A-Za-z0-9_.:-]+")
+
+
+def _trace_files(profile_dir: str) -> list:
+    """Every trace dump under the jax.profiler layout, gz or plain.
+
+    The same dump may exist in both forms (e.g. after ``gunzip -k`` for
+    manual inspection): keep the gz and drop its plain twin so the run
+    is not summarised — and its totals double-counted — twice.
+    """
+    found = set()
+    for pattern in ("*.trace.json.gz", "*.trace.json"):
+        found.update(glob.glob(os.path.join(
+            profile_dir, "plugins", "profile", "*", pattern)))
+    for path in list(found):
+        if path.endswith(".gz"):
+            found.discard(path[:-3])
+    return sorted(found)
+
+
+def _load_trace(path: str) -> dict:
+    if path.endswith(".gz"):
+        with gzip.open(path) as fh:
+            return json.load(fh)
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def _event_scope(event: dict):
+    """The ``pert/<phase>`` named_scope an event belongs to, or None.
+
+    The scope string may land in the event name itself or in the args
+    metadata (XLA attaches it as op metadata ``name``/``long_name``
+    depending on backend/version) — scan both.  When scopes nest
+    ("pert/decode/pert/qc_entropy/mul") the innermost — last — match
+    wins, so nested regions are not folded into their parent.
+    """
+    matches = _SCOPE_RE.findall(event.get("name", ""))
+    if matches:
+        return matches[-1]
+    args = event.get("args")
+    if isinstance(args, dict):
+        for value in args.values():
+            if isinstance(value, str):
+                matches = _SCOPE_RE.findall(value)
+                if matches:
+                    return matches[-1]
+    return None
+
 
 def summarise(profile_dir: str, top: int = 12) -> str:
     lines = [f"# jax.profiler trace summary for {profile_dir}",
              "# top ops by total self-duration per captured trace "
              "(bookkeeping frames filtered)", ""]
-    traces = sorted(glob.glob(os.path.join(
-        profile_dir, "plugins", "profile", "*", "*.trace.json.gz")))
+    traces = _trace_files(profile_dir)
     if not traces:
-        raise SystemExit(f"no *.trace.json.gz under {profile_dir}")
+        raise SystemExit(
+            f"trace_summary: no *.trace.json or *.trace.json.gz traces "
+            f"under {profile_dir} — expected the jax.profiler layout "
+            f"{profile_dir}/plugins/profile/<run>/<host>.trace.json(.gz); "
+            f"write traces with PertConfig(profile_dir=...) or "
+            f"full_pipeline_bench.py --profile-dir")
     for path in traces:
-        with gzip.open(path) as fh:
-            data = json.load(fh)
+        data = _load_trace(path)
         events = [e for e in data.get("traceEvents", [])
                   if e.get("ph") == "X"]
         total = collections.Counter()
+        scopes = collections.Counter()
         for e in events:
             total[e.get("name", "?")] += e.get("dur", 0)
+            scope = _event_scope(e)
+            if scope:
+                scopes[scope] += e.get("dur", 0)
         lines.append(f"== {path.split(os.sep)[-2]}  ({len(events)} events)")
         shown = 0
         for name, dur in total.most_common(200):
@@ -55,6 +124,11 @@ def summarise(profile_dir: str, top: int = 12) -> str:
             shown += 1
             if shown >= top:
                 break
+        if scopes:
+            lines.append("   -- named_scope groups (time by pipeline "
+                         "phase) --")
+            for scope, dur in scopes.most_common():
+                lines.append(f"   {dur / 1e6:10.2f}s  {scope}")
         lines.append("")
     return "\n".join(lines)
 
