@@ -44,7 +44,14 @@ class scRT:
     posterior-confidence maps, convergence doctor, posterior-predictive
     checks and the :meth:`cell_qc` table, tunable via
     ``qc_entropy_thresh``/``qc_frac_thresh``/``qc_ppc_replicates``/
-    ``qc_ppc_z``; ``clustering_method`` selects the
+    ``qc_ppc_z``; ``controller`` (default True) enables the adaptive
+    fit controller (obs/controller.py) — fits run as compiled chunks
+    and may early-stop / extend / re-seed / escalate on the
+    flight-recorder signals, with every decision audited as a
+    ``control_decision`` RunLog event (``controller=False`` restores
+    the fixed-budget single-program fits bit-exactly, and
+    ``controller_max_extra_iters`` caps extensions, None = half the
+    fit's budget); ``clustering_method`` selects the
     G1 clone-discovery algorithm when ``clone_col=None`` (``'kmeans'``
     as the reference hardwires, or ``'umap_hdbscan'`` — its optional
     cncluster path), with ``clustering_kwargs`` forwarded to it.
@@ -71,6 +78,7 @@ class scRT:
                  fit_diag_every=25,
                  qc=True, qc_entropy_thresh=0.5, qc_frac_thresh=0.25,
                  qc_ppc_replicates=8, qc_ppc_z=5.0,
+                 controller=True, controller_max_extra_iters=None,
                  clustering_method='kmeans', clustering_kwargs=None):
         self.cn_s = cn_s
         self.cn_g1 = cn_g1
@@ -110,6 +118,8 @@ class scRT:
             qc=qc, qc_entropy_thresh=qc_entropy_thresh,
             qc_frac_thresh=qc_frac_thresh,
             qc_ppc_replicates=qc_ppc_replicates, qc_ppc_z=qc_ppc_z,
+            controller=controller,
+            controller_max_extra_iters=controller_max_extra_iters,
         )
 
         self.clone_profiles = None

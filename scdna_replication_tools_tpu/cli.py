@@ -74,6 +74,22 @@ def infer_scrt_main(argv=None):
     p.add_argument("--qc-output", default=None,
                    help="also write the per-cell QC table (scRT.cell_qc()) "
                         "to this tsv")
+    p.add_argument("--controller", action=BooleanOptionalAction,
+                   default=True,
+                   help="adaptive fit controller (default ON): fits run "
+                        "as compiled chunks and may early-stop when the "
+                        "convergence doctor reads the tail as converged, "
+                        "extend plateaued fits, re-seed oscillating ones "
+                        "and escalate NaN aborts — every decision is a "
+                        "control_decision event in the run log; "
+                        "--no-controller restores the fixed-budget "
+                        "single-program fits bit-exactly "
+                        "(PertConfig.controller)")
+    p.add_argument("--controller-max-extra-iters", type=int, default=None,
+                   help="cap on the total extra iterations the controller "
+                        "may grant one fit beyond its budget (default: "
+                        "half the fit's max_iter; "
+                        "PertConfig.controller_max_extra_iters)")
     args = p.parse_args(argv)
 
     from scdna_replication_tools_tpu.api import scRT
@@ -89,7 +105,9 @@ def infer_scrt_main(argv=None):
                 compile_cache_dir=args.compile_cache,
                 telemetry_path=args.telemetry,
                 qc=args.qc, qc_entropy_thresh=args.qc_entropy_thresh,
-                qc_ppc_z=args.qc_ppc_z)
+                qc_ppc_z=args.qc_ppc_z,
+                controller=args.controller,
+                controller_max_extra_iters=args.controller_max_extra_iters)
     out_df, supp_df, _, _ = scrt.infer(level=args.level)
 
     out_df.to_csv(args.output, sep="\t", index=False)

@@ -166,6 +166,49 @@ class PertConfig:
     doctor_slope_tol: float = 1e-4
     doctor_var_tol: float = 1e-3
     doctor_grad_ratio: float = 0.1
+    # --- adaptive fit controller (obs/controller.py; default ON) ---
+    # closes the observability -> control loop: the fit runs as an outer
+    # host loop over jit-compiled fixed-size chunks (chunk size =
+    # fit_diag_every; ONE compiled program reused for every chunk) and
+    # between chunks the controller reads the flight-recorder tail and
+    # may early-stop a doctor-converged fit (reclaiming the remaining
+    # budget), extend a plateaued one, re-seed an oscillating one from
+    # the best-loss checkpoint, or escalate a NaN abort through a
+    # checkpoint + one reduced-LR retry.  Every decision lands as a
+    # control_decision RunLog event (schema v3).  False restores the
+    # single whole-budget lax.while_loop bit-exactly.  The controller is
+    # inert (no decisions) when min_iter >= max_iter (a pinned exact
+    # budget), when fit_diag_every == 0 (no flight recorder to read), or
+    # while fewer than doctor_window loss samples exist.
+    controller: bool = True
+    # total extra iterations one fit may be granted beyond its budget;
+    # None resolves to max_iter // 2 for that fit
+    controller_max_extra_iters: Optional[int] = None
+    # iterations granted per extend decision (the controller re-evaluates
+    # at the new exhaustion point)
+    controller_extend_step: int = 50
+    # re-seed attempts per fit (oscillating/diverging verdicts)
+    controller_max_reseeds: int = 1
+    # relative scale of the re-seed perturbation around the best-loss
+    # checkpoint (per-leaf: scale * (std(leaf) + 1e-3))
+    controller_reseed_scale: float = 0.02
+    # learning-rate factor for the one NaN-escalation retry
+    controller_nan_lr_factor: float = 0.1
+    # best-loss stagnation stop (the trigger that actually reclaims
+    # budget on PERT's noisy tails, where the doctor's strict
+    # tail-flatness `converged` almost never fires): early-stop once the
+    # BEST loss — monotone, spike-robust — improved by less than
+    # controller_stop_ftol of the fit's total improvement over the last
+    # controller_stop_patience iterations; 0 disables the rule
+    controller_stop_patience: int = 50
+    controller_stop_ftol: float = 3e-3
+    # rescue gating (controller ON): the mirror rescue runs only when a
+    # boundary-tau candidate is also SUSPECT — fitted tau within this
+    # distance of 0/1 (mirror victims land at ~0.005; genuinely early/
+    # late-S cells higher), or flagged high-entropy by the QC signals
+    # (frac of low-confidence bins > qc_frac_thresh).  With the
+    # controller off the rescue stays always-on as before.
+    controller_rescue_extreme_tau: float = 0.02
     # optional genome-smoothed CN decode: Viterbi over loci with this
     # self-transition probability — a simplified stand-in inspired by
     # the transition machinery the reference defines but never uses

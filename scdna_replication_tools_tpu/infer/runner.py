@@ -42,13 +42,16 @@ from scdna_replication_tools_tpu.models import priors
 from scdna_replication_tools_tpu.models.pert import (
     PertBatch,
     PertModelSpec,
+    cell_entropy_aggregates,
     constrained,
     decode_discrete,
+    entropy_aggregates_from_planes,
     init_params,
     per_cell_objective,
     pert_loss,
     ppc_discrepancy,
 )
+from scdna_replication_tools_tpu.obs.controller import ControllerPolicy
 from scdna_replication_tools_tpu.ops.gc import gc_features
 from scdna_replication_tools_tpu.ops.stats import guess_times, pearson_matrix
 from scdna_replication_tools_tpu.obs.runlog import RunLog
@@ -346,6 +349,18 @@ class PertInference:
 
     # -- steps ------------------------------------------------------------
 
+    def _controller_active(self, min_iter, max_iter) -> bool:
+        """The documented inert conditions (config.py, OBSERVABILITY.md)
+        in ONE place for both the in-fit controller and the step-2
+        rescue gate: the controller needs a flight recorder to read
+        (``fit_diag_every > 0``) and a budget that is not pinned exact
+        (``min_iter < max_iter`` — e.g. the donation/resume exactness
+        tests run min == max and must see the untouched fixed
+        trajectory, with no gating anywhere)."""
+        cfg = self.config
+        return bool(cfg.controller and cfg.fit_diag_every
+                    and int(min_iter) < int(max_iter))
+
     def _fit(self, spec, batch, fixed, t_init, max_iter, min_iter,
              step_name) -> StepOutput:
         cfg = self.config
@@ -364,10 +379,18 @@ class PertInference:
                     completed=bool(converged or nan_abort
                                    or num_iters >= max_iter))
                 if converged or nan_abort or num_iters >= max_iter:
-                    # completed step: restore as-is, no refit
+                    # completed step: restore as-is, no refit.  budget
+                    # must be a real integer — the rescue gate's
+                    # control_decision event types it as such in the
+                    # schema, restored fits included.  The checkpoint
+                    # does not persist a controller-extended budget, so
+                    # a fit that ran past max_iter restores with its
+                    # own iteration count as the floor (iter > budget
+                    # would contradict the audit trail)
                     fit = FitResult(params=params, losses=losses,
                                     num_iters=num_iters, converged=converged,
-                                    nan_abort=nan_abort)
+                                    nan_abort=nan_abort,
+                                    budget=max(int(max_iter), num_iters))
                     return StepOutput(fit, spec, fixed, batch, 0.0)
                 # partial step: resume from the saved iteration with Adam
                 # moments intact (exact continuation of the trajectory)
@@ -393,6 +416,10 @@ class PertInference:
 
         loss_fn = _PertLossFn(spec=spec, mesh=mesh)
 
+        controller = None
+        if self._controller_active(min_iter, max_iter):
+            controller = ControllerPolicy.from_config(cfg, max_iter)
+
         t0 = time.perf_counter()
         with profiling.trace(cfg.profile_dir):
             fit = fit_map(loss_fn, params0, (fixed, batch),
@@ -407,7 +434,10 @@ class PertInference:
                               window=cfg.doctor_window,
                               slope_tol=cfg.doctor_slope_tol,
                               var_tol=cfg.doctor_var_tol,
-                              grad_ratio=cfg.doctor_grad_ratio))
+                              grad_ratio=cfg.doctor_grad_ratio),
+                          controller=controller,
+                          escalate_dir=cfg.checkpoint_dir,
+                          escalate_tag=step_name)
         wall = time.perf_counter() - t0
         for key in ("trace", "compile", "fit"):
             self.phases.add(f"{step_name}/{key}", fit.timings.get(key, 0.0))
@@ -453,6 +483,11 @@ class PertInference:
         ``prior_iters``: iterations restored from a checkpoint — counted
         in ``iters`` (the fit's total) but NOT in the throughput rates,
         whose wall covers only the resumed segment."""
+        # the controller's audit trail first — the decisions happened
+        # DURING the fit the fit_end event summarises
+        for decision in fit.decisions:
+            self.run_log.emit("control_decision", step=step_name,
+                              **decision)
         iters = max(fit.num_iters - prior_iters, 1)
         diag = None
         if fit.diagnostics is not None and len(fit.diagnostics["iter"]):
@@ -569,8 +604,18 @@ class PertInference:
                         iters["max_iter"], iters["min_iter"], "step2")
         self._step2_data = s
         if self.config.mirror_rescue:
-            with self.phases.phase("step2/rescue"):
-                out = self._mirror_rescue(out, batch)
+            # controller active: the rescue sub-fit runs only when the
+            # QC signals say a candidate is SUSPECT (extreme-boundary
+            # tau or high posterior entropy) instead of always-on; the
+            # gate verdict lands as a control_decision event either way.
+            # An inert controller (same conditions as the in-fit path)
+            # leaves the rescue always-on and emits nothing.
+            run_rescue = self._gate_rescue(out, batch) \
+                if self._controller_active(iters["min_iter"],
+                                           iters["max_iter"]) else True
+            if run_rescue:
+                with self.phases.phase("step2/rescue"):
+                    out = self._mirror_rescue(out, batch)
         else:
             # reference-faithful path: no behaviour change, but surface
             # the symptom the opt-in rescue exists for
@@ -596,6 +641,110 @@ class PertInference:
         cand = np.flatnonzero(((tau < cfg.mirror_tau_lo)
                                | (tau > cfg.mirror_tau_hi)) & (mask > 0.5))
         return tau, cand
+
+    def _gate_rescue(self, out: StepOutput, batch: PertBatch) -> bool:
+        """Controller gate for the mirror rescue (ISSUE 6 / ROADMAP 5):
+        run the sub-fit only when a boundary-tau candidate is also
+        SUSPECT — fitted tau within ``controller_rescue_extreme_tau`` of
+        0/1 (true mirror victims land at ~0.005; genuinely early/late-S
+        cells higher) or flagged high-entropy by the posterior-
+        confidence QC signals (frac of low-confidence bins above
+        ``qc_frac_thresh``).  Replaces the always-on heuristic: a cohort
+        whose boundary cells are confident, non-extreme fits (the
+        legitimately-early/late-S case the candidate cap exists for)
+        skips the whole refit-and-reject cycle.  The entropy signal is
+        consulted only when the extreme-tau test alone has not already
+        gated the rescue IN, and only when ``qc`` is enabled —
+        ``--no-qc`` leaves the extreme-tau test as the sole gate.
+
+        Emits one ``control_decision`` event (action ``rescue`` /
+        ``rescue_skip``) carrying the trigger signals; on a skip, the
+        rescue bookkeeping (stats, QC candidate flags, the ``rescue``
+        event) is still produced so downstream consumers see the same
+        surface as a 0-accepted pass.
+        """
+        cfg = self.config
+        tau, cand = self._mirror_candidates(out, batch)
+        trigger: dict = {"candidates": int(cand.size)}
+        thresholds = {
+            "mirror_tau_lo": float(cfg.mirror_tau_lo),
+            "mirror_tau_hi": float(cfg.mirror_tau_hi),
+            "extreme_tau": float(cfg.controller_rescue_extreme_tau),
+            "entropy_thresh": float(cfg.qc_entropy_thresh),
+            "frac_thresh": float(cfg.qc_frac_thresh),
+        }
+        run = False
+        if cand.size:
+            extremity = np.minimum(tau[cand], 1.0 - tau[cand])
+            extreme = extremity < cfg.controller_rescue_extreme_tau
+            run = bool(extreme.any())
+            trigger.update(
+                extreme_tau_count=int(extreme.sum()),
+                suspect_count=int(extreme.sum()),
+                min_extremity=self._finite(extremity.min()))
+            if not run and not cfg.qc:
+                # --no-qc opts out of the whole posterior-confidence
+                # surface, the gate's entropy signal included — the
+                # gate then decides on the extreme-tau test alone
+                # (also avoiding an entropy decode program the
+                # packaging pass would never build to share)
+                trigger["qc"] = "off"
+            elif not run:
+                # posterior-confidence signal, on device — consulted
+                # only when the cheap extreme-tau test alone has not
+                # already gated the rescue IN (an extreme candidate is
+                # suspect regardless of entropy, so the decode sweep
+                # would change nothing).  Full-cohort on purpose: the
+                # slab program is shape-stable and shared with the
+                # packaging decode, where a candidates-only sub-batch
+                # would recompile per candidate count.  The aggregates
+                # are NOT cached for packaging: packaging needs the
+                # per-bin entropy PLANES (the model_cn_entropy column),
+                # and keeping two (cells, loci) f32 planes alive in HBM
+                # across the step-3 fit to save one gate sweep inverts
+                # the footprint priorities — packaging recomputes them
+                # inside the decode pass it runs anyway.
+                with self.phases.phase("step2/rescue_gate"):
+                    # cell_chunk default (auto-slab) so the compiled
+                    # slab program is the SAME one packaging reuses
+                    _, frac_low, mean_rep = jax.device_get(
+                        cell_entropy_aggregates(
+                            out.spec, out.fit.params, out.fixed, batch,
+                            entropy_thresh=cfg.qc_entropy_thresh))
+                high_ent = np.asarray(frac_low)[cand] > cfg.qc_frac_thresh
+                run = bool(high_ent.any())
+                trigger.update(
+                    high_entropy_count=int(high_ent.sum()),
+                    suspect_count=int(high_ent.sum()),
+                    max_frac_low_conf=self._finite(
+                        np.asarray(frac_low)[cand].max()),
+                    mean_rep_entropy=self._finite(
+                        float(np.mean(np.asarray(mean_rep)[cand]))))
+        self.run_log.emit(
+            "control_decision", step="step2",
+            action="rescue" if run else "rescue_skip",
+            iter=int(out.fit.num_iters),
+            # schema types budget as integer; a fit built outside the
+            # controlled driver falls back to the iterations it ran
+            budget=int(out.fit.budget if out.fit.budget is not None
+                       else out.fit.num_iters),
+            trigger=trigger, thresholds=thresholds,
+            detail=("mirror rescue gated IN: suspect boundary-tau "
+                    "candidates present" if run else
+                    "mirror rescue gated OUT: no suspect boundary-tau "
+                    "candidates (no wasted refit-and-reject sub-fit)"))
+        if not run:
+            # same downstream surface as a 0-accepted rescue pass
+            self.mirror_rescue_stats = {"candidates": int(cand.size),
+                                        "accepted": 0}
+            self._rescue_cells = {"candidates": cand.copy(),
+                                  "accepted": np.zeros(0, cand.dtype)}
+            self._emit_rescue_event()
+            profiling.logger.info(
+                "mirror rescue skipped by the controller: %d boundary-"
+                "tau candidate(s), none extreme or high-entropy",
+                cand.size)
+        return run
 
     def _mirror_rescue(self, out: StepOutput, batch: PertBatch) -> StepOutput:
         """Post-step-2 mirror-basin rescue (``PertConfig.mirror_rescue``).
@@ -1035,22 +1184,12 @@ def package_step_output(
         with timer.phase(f"{phase_prefix}/qc_aggregate"):
             # per-cell confidence aggregates reduced on device — the
             # fetch moves (cells,) vectors, not extra (cells, loci)
-            # planes beyond the one entropy map the output carries
+            # planes beyond the one entropy map the output carries.
+            # Same reduction the rescue gate consumes standalone.
             cn_ent, rep_ent = ent_planes
-            lmask = batch.effective_loci_mask()
-            denom = jnp.maximum(jnp.sum(lmask), 1.0)
-            qc_device = {
-                "mean_cn_entropy":
-                    jnp.sum(cn_ent * lmask[None, :], axis=1) / denom,
-                "max_cn_entropy":
-                    jnp.max(jnp.where(lmask[None, :] > 0, cn_ent, 0.0),
-                            axis=1),
-                "frac_low_conf":
-                    jnp.sum((cn_ent > qc_entropy_thresh) * lmask[None, :],
-                            axis=1) / denom,
-                "mean_rep_entropy":
-                    jnp.sum(rep_ent * lmask[None, :], axis=1) / denom,
-            }
+            qc_device = entropy_aggregates_from_planes(
+                cn_ent, rep_ent, batch.effective_loci_mask(),
+                qc_entropy_thresh, want_max=True)
 
     with timer.phase(f"{phase_prefix}/fetch"):
         # one bulk device->host transfer for every packaged plane; only

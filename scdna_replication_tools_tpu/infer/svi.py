@@ -66,6 +66,13 @@ class FitResult:
     # the full doctor report behind ``verdict``: reason, relative tail
     # drift/variance, gradient-norm decay — the ``fit_health`` telemetry
     # event's payload (infer/runner.py emits it)
+    decisions: list = dataclasses.field(default_factory=list)
+    # the adaptive controller's audit trail for this fit (empty when the
+    # controller is off or never acted): one dict per decision, emitted
+    # verbatim as ``control_decision`` RunLog events by the runner
+    budget: Optional[int] = None
+    # the FINAL iteration budget the fit ran under (== the configured
+    # max_iter unless the controller granted extensions)
 
 
 def _window_stat(losses, i, win_size):
@@ -77,29 +84,23 @@ def _window_stat(losses, i, win_size):
     return jnp.max(win) - jnp.min(win)
 
 
-# params0 / opt_state0 / losses0 / diag0 are initial-value pytrees, dead
-# the moment the loop consumes them — donating them lets XLA reuse their
-# buffers for the loop carry instead of copying on entry (at the
-# 10k-cell scale pi_logits alone is ~2.8 GB; without donation every fit
-# pays that copy in HBM churn and transient footprint).  Checkpoint
-# resume stays bit-exact: donation recycles buffers, it never changes
-# values, and every caller builds these pytrees fresh per fit (pinned by
-# tests/test_donation.py).
-@functools.partial(jax.jit, static_argnames=("loss_fn", "max_iter", "min_iter",
-                                             "lr", "b1", "b2", "diag_every"),
-                   donate_argnames=("params0", "opt_state0", "losses0",
-                                    "diag0"))
-def _run_fit(loss_fn: Callable, params0: dict, opt_state0, losses0, diag0,
-             i0, loss_args: tuple,
-             max_iter: int, min_iter: int, rel_tol: float,
-             lr: float, b1: float, b2: float, diag_every: int):
+def _fit_loop(loss_fn: Callable, lr, b1: float, b2: float,
+              loss_args: tuple, diag_every: int, conv_window: int,
+              bound, min_iter, rel_tol, init):
+    """The shared per-iteration fit loop of :func:`_run_fit` and
+    :func:`_run_fit_chunk` — ONE copy of the iteration math, so the
+    fixed and chunked paths cannot drift apart.  ``bound`` / ``min_iter``
+    / ``rel_tol`` / ``lr`` may be Python scalars (fixed path: baked into
+    the program) or traced device scalars (chunk path: one program
+    serves every chunk of every budget); ``conv_window`` is always
+    static (it sizes a dynamic_slice)."""
     tx = optax.adam(learning_rate=lr, b1=b1, b2=b2)
 
     value_and_grad = jax.value_and_grad(loss_fn)
 
     def cond(carry):
         i, _, _, _, _, done, _, _ = carry
-        return jnp.logical_and(i < max_iter, jnp.logical_not(done))
+        return jnp.logical_and(i < bound, jnp.logical_not(done))
 
     def body(carry):
         # named_scope: groups this region's device time under one label
@@ -137,18 +138,65 @@ def _run_fit(loss_fn: Callable, params0: dict, opt_state0, losses0, diag0,
 
         is_nan = jnp.isnan(loss)
         denom = jnp.abs(losses[0] - loss)
-        # window clamped so tiny smoke-test budgets (max_iter < 9) compile
-        loss_diff = _window_stat(losses, i, min(9, max_iter)) / denom
+        loss_diff = _window_stat(losses, i, conv_window) / denom
         converged = jnp.logical_and(i >= min_iter, loss_diff < rel_tol)
         done = jnp.logical_or(is_nan, converged)
         return (i + 1, params, opt_state, losses, diag, done, converged,
                 is_nan)
 
-    init = (jnp.asarray(i0), params0, opt_state0, losses0, diag0,
-            jnp.asarray(False), jnp.asarray(False), jnp.asarray(False))
     (i, params, opt_state, losses, diag, _, converged,
      is_nan) = jax.lax.while_loop(cond, body, init)
     return i, params, opt_state, losses, diag, converged, is_nan
+
+
+# params0 / opt_state0 / losses0 / diag0 are initial-value pytrees, dead
+# the moment the loop consumes them — donating them lets XLA reuse their
+# buffers for the loop carry instead of copying on entry (at the
+# 10k-cell scale pi_logits alone is ~2.8 GB; without donation every fit
+# pays that copy in HBM churn and transient footprint).  Checkpoint
+# resume stays bit-exact: donation recycles buffers, it never changes
+# values, and every caller builds these pytrees fresh per fit (pinned by
+# tests/test_donation.py).
+@functools.partial(jax.jit, static_argnames=("loss_fn", "max_iter", "min_iter",
+                                             "lr", "b1", "b2", "diag_every"),
+                   donate_argnames=("params0", "opt_state0", "losses0",
+                                    "diag0"))
+def _run_fit(loss_fn: Callable, params0: dict, opt_state0, losses0, diag0,
+             i0, loss_args: tuple,
+             max_iter: int, min_iter: int, rel_tol: float,
+             lr: float, b1: float, b2: float, diag_every: int):
+    init = (jnp.asarray(i0), params0, opt_state0, losses0, diag0,
+            jnp.asarray(False), jnp.asarray(False), jnp.asarray(False))
+    # window clamped so tiny smoke-test budgets (max_iter < 9) compile
+    return _fit_loop(loss_fn, lr, b1, b2, loss_args, diag_every,
+                     min(9, max_iter), max_iter, min_iter, rel_tol, init)
+
+
+# Chunked twin of ``_run_fit`` for the adaptive controller
+# (obs/controller.py): identical per-iteration math (the shared
+# ``_fit_loop``), but the loop bound ``stop`` — and min_iter / rel_tol /
+# the learning rate — are DYNAMIC scalars, so ONE compiled program
+# serves every chunk of every budget (including controller-granted
+# extensions and the reduced-LR NaN retry); compile cost is unchanged
+# versus the whole-budget program.  ``conv_window`` is the SAME
+# ``min(9, max_iter)`` clamp the fixed path bakes in (it sizes a
+# dynamic_slice, so it must stay static).  ``params0`` is deliberately
+# NOT donated: the host driver keeps the chunk-entry params alive as the
+# best-loss checkpoint the re-seed and NaN-escalation actions restart
+# from (one extra live params copy — documented in PERF_NOTES).  The
+# consumed-on-entry carries (opt/losses/diag) are still donated.
+@functools.partial(jax.jit, static_argnames=("loss_fn", "conv_window",
+                                             "b1", "b2", "diag_every"),
+                   donate_argnames=("opt_state0", "losses0", "diag0"))
+def _run_fit_chunk(loss_fn: Callable, params0: dict, opt_state0, losses0,
+                   diag0, i0, stop, min_iter, rel_tol, lr,
+                   loss_args: tuple,
+                   conv_window: int, b1: float, b2: float,
+                   diag_every: int):
+    init = (i0, params0, opt_state0, losses0, diag0,
+            jnp.asarray(False), jnp.asarray(False), jnp.asarray(False))
+    return _fit_loop(loss_fn, lr, b1, b2, loss_args, diag_every,
+                     conv_window, stop, min_iter, rel_tol, init)
 
 
 def make_opt_state(params: dict, learning_rate: float = 0.05,
@@ -203,13 +251,18 @@ def _key_hash(key) -> str:
     return hashlib.sha256(repr(key).encode()).hexdigest()[:12]
 
 
-def _get_compiled(loss_fn, dynamic_args, rel_tol, statics, timings: dict):
-    """Compiled _run_fit program for this signature, timed on miss.
+def _resolve_program(target, tag: str, loss_fn, dynamic_args,
+                     dynamic_kwargs: dict, static_kwargs: dict,
+                     timings: dict):
+    """Compiled program of ``target`` for this signature, timed on miss.
 
-    ``rel_tol`` is a DYNAMIC scalar (passed by keyword at lowering time,
-    so the compiled program is reusable across tolerance values); the
-    caller must invoke the result as ``compiled(*dynamic_args,
-    rel_tol=...)`` to match the lowered pytree.
+    Shared by the whole-budget program (``_run_fit``) and the
+    controller's chunk program (``_run_fit_chunk``); ``tag`` keeps their
+    cache keys apart.  Entries in ``dynamic_kwargs`` are DYNAMIC scalars
+    passed by keyword at lowering time — the compiled program is
+    reusable across their values, and the caller must invoke the result
+    as ``compiled(*dynamic_args, **dynamic_kwargs)`` to match the
+    lowered pytree.
 
     Every resolution emits a telemetry ``compile`` event to the active
     RunLog (no-op outside a session): content hash, hit/miss,
@@ -217,7 +270,8 @@ def _get_compiled(loss_fn, dynamic_args, rel_tol, statics, timings: dict):
     memory_analysis footprint (cached alongside the program so warm runs
     still report their memory high-water)."""
     try:
-        key = (loss_fn, statics, _abstract_sig(dynamic_args))
+        key = (tag, loss_fn, tuple(sorted(static_kwargs.items())),
+               _abstract_sig((dynamic_args, dynamic_kwargs)))
         hash(key)
     except TypeError:
         _runlog.current().emit("compile", key_hash="unhashable",
@@ -234,12 +288,9 @@ def _get_compiled(loss_fn, dynamic_args, rel_tol, statics, timings: dict):
                                trace_seconds=0.0, compile_seconds=0.0,
                                **stats)
         return compiled
-    max_iter, min_iter, lr, b1, b2, diag_every = statics
     t0 = time.perf_counter()
-    lowered = _run_fit.lower(loss_fn, *dynamic_args,
-                             max_iter=max_iter, min_iter=min_iter,
-                             rel_tol=rel_tol, lr=lr, b1=b1, b2=b2,
-                             diag_every=diag_every)
+    lowered = target.lower(loss_fn, *dynamic_args, **dynamic_kwargs,
+                           **static_kwargs)
     t1 = time.perf_counter()
     compiled = lowered.compile()
     t2 = time.perf_counter()
@@ -263,6 +314,8 @@ def fit_map(loss_fn: Callable, params0: dict, loss_args: tuple = (),
             opt_state0=None, losses_prefix: Optional[np.ndarray] = None,
             diag_every: int = 0,
             doctor_thresholds: Optional[dict] = None,
+            controller=None, escalate_dir: Optional[str] = None,
+            escalate_tag: str = "fit",
             ) -> FitResult:
     """Fit ``params`` by MAP ascent of ``-loss_fn`` with reference semantics.
 
@@ -299,7 +352,31 @@ def fit_map(loss_fn: Callable, params0: dict, loss_args: tuple = (),
     the doctor's window/slope_tol/var_tol/grad_ratio defaults (the
     runner passes ``PertConfig``'s).  Host-side on the already-fetched
     loss history — adds no device work.
+
+    ``controller`` (an ``obs.controller.ControllerPolicy``; requires
+    ``diag_every > 0``) switches the single whole-budget
+    ``lax.while_loop`` for an outer host loop over jit-compiled
+    fixed-size chunks of ``diag_every`` iterations — ONE compiled
+    program reused for every chunk — and between chunks evaluates the
+    flight-recorder signals: a doctor-``converged`` partial tail
+    early-stops the fit (reclaiming the remaining budget), a
+    ``plateaued`` fit at exhaustion is granted extra iterations, an
+    ``oscillating`` one is re-seeded from the best-loss checkpoint, and
+    a NaN-poisoned chunk escalates through a checkpoint save
+    (``escalate_dir``/``escalate_tag``) plus one reduced-LR retry before
+    aborting.  The audit trail lands on ``FitResult.decisions``.
+    ``controller=None`` (the default) keeps the original single-program
+    path bit-exactly.
     """
+    if controller is not None and diag_every:
+        return _fit_map_controlled(
+            loss_fn, params0, loss_args, max_iter=max_iter,
+            min_iter=min_iter, rel_tol=rel_tol,
+            learning_rate=learning_rate, b1=b1, b2=b2,
+            opt_state0=opt_state0, losses_prefix=losses_prefix,
+            diag_every=diag_every, doctor_thresholds=doctor_thresholds,
+            policy=controller, escalate_dir=escalate_dir,
+            escalate_tag=escalate_tag)
     if opt_state0 is None:
         params0 = jax.tree_util.tree_map(jnp.asarray, params0)
         opt_state0 = make_opt_state(params0, learning_rate, b1, b2)
@@ -329,22 +406,22 @@ def fit_map(loss_fn: Callable, params0: dict, loss_args: tuple = (),
     diag0 = jnp.zeros((DIAG_RING if diag_every else 0, 3), jnp.float32)
 
     rel_tol = float(rel_tol)
-    statics = (int(max_iter), int(min_iter),
-               float(learning_rate), float(b1), float(b2), diag_every)
+    static_kwargs = dict(max_iter=int(max_iter), min_iter=int(min_iter),
+                         lr=float(learning_rate), b1=float(b1),
+                         b2=float(b2), diag_every=diag_every)
     dynamic_args = (params0, opt_state0, losses0, diag0, i0, loss_args)
     timings: dict = {"trace": 0.0, "compile": 0.0}
-    compiled = _get_compiled(loss_fn, dynamic_args, rel_tol, statics,
-                             timings)
+    compiled = _resolve_program(_run_fit, "fit", loss_fn, dynamic_args,
+                                {"rel_tol": rel_tol}, static_kwargs,
+                                timings)
 
     t0 = time.perf_counter()
     if compiled is not None:
         out = compiled(*dynamic_args, rel_tol=rel_tol)
     else:
         timings["program_cache"] = "uncacheable"
-        out = _run_fit(loss_fn, *dynamic_args,
-                       max_iter=statics[0], min_iter=statics[1],
-                       rel_tol=rel_tol, lr=statics[2], b1=statics[3],
-                       b2=statics[4], diag_every=diag_every)
+        out = _run_fit(loss_fn, *dynamic_args, rel_tol=rel_tol,
+                       **static_kwargs)
     i, params, opt_state, losses, diag, converged, is_nan = out
     n = int(i)
     losses_host = np.asarray(losses)[:n]
@@ -365,7 +442,259 @@ def fit_map(loss_fn: Callable, params0: dict, loss_args: tuple = (),
         diagnostics=diagnostics,
         verdict=health["verdict"],
         health=health,
+        budget=int(max_iter),
     )
+
+
+def _perturb_params(params: dict, scale: float, seed: int, salt: int):
+    """Deterministic re-seed perturbation around a checkpointed pytree.
+
+    Per-leaf relative scale (``scale * (std(leaf) + 1e-3)``) so flat and
+    wide leaves both move; keyed by (seed, salt) so the same run always
+    re-seeds identically — the decision trail must be reproducible.
+    On-device ops, so sharded params stay sharded.
+    """
+    key = jax.random.fold_in(jax.random.PRNGKey(int(seed)), int(salt))
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    keys = jax.random.split(key, max(len(leaves), 1))
+    out = []
+    for leaf, k in zip(leaves, keys):
+        leaf = jnp.asarray(leaf)
+        sigma = scale * (jnp.std(leaf) + 1e-3)
+        out.append(leaf + sigma * jax.random.normal(k, leaf.shape,
+                                                    leaf.dtype))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _fit_map_controlled(loss_fn: Callable, params0: dict, loss_args: tuple,
+                        max_iter: int, min_iter: int, rel_tol: float,
+                        learning_rate: float, b1: float, b2: float,
+                        opt_state0, losses_prefix, diag_every: int,
+                        doctor_thresholds: Optional[dict], policy,
+                        escalate_dir: Optional[str],
+                        escalate_tag: str) -> FitResult:
+    """Adaptive (chunked) twin of :func:`fit_map` — see its docstring.
+
+    The outer loop runs on the host; each chunk is one dispatch of the
+    single compiled ``_run_fit_chunk`` program (``diag_every``
+    iterations, or fewer at a budget edge).  Between chunks the
+    controller policy (obs/controller.py) reads the fetched loss
+    trajectory + the diagnostics ring-buffer tail and issues decisions;
+    this function applies them to the device state and records the
+    audit trail on ``FitResult.decisions``.
+    """
+    from scdna_replication_tools_tpu.obs import controller as _controller
+
+    max_iter = int(max_iter)
+    min_iter = int(min_iter)
+    diag_every = int(diag_every)
+    buf_len = max_iter + max(int(policy.max_extra_iters), 0)
+
+    if opt_state0 is None:
+        params0 = jax.tree_util.tree_map(jnp.asarray, params0)
+        opt_state0 = make_opt_state(params0, learning_rate, b1, b2)
+    else:
+        # resume path: copy before the chunk program donates (see
+        # fit_map's fixed path — same contract)
+        copy = functools.partial(jnp.array, copy=True)
+        params0 = jax.tree_util.tree_map(copy, params0)
+        opt_state0 = jax.tree_util.tree_map(copy, opt_state0)
+    i0_host = 0
+    losses = jnp.zeros((buf_len,), jnp.float32)
+    if losses_prefix is not None and len(losses_prefix) > 0:
+        i0_host = min(int(len(losses_prefix)), max_iter)
+        losses = losses.at[:i0_host].set(
+            jnp.asarray(losses_prefix[:i0_host], jnp.float32))
+    diag = jnp.zeros((DIAG_RING, 3), jnp.float32)
+
+    static_kwargs = dict(conv_window=min(9, max_iter), b1=float(b1),
+                         b2=float(b2), diag_every=diag_every)
+    # dynamic scalars with pinned dtypes so every chunk hits the same
+    # compiled program
+    as_i32 = functools.partial(jnp.asarray, dtype=jnp.int32)
+    as_f32 = functools.partial(jnp.asarray, dtype=jnp.float32)
+    rel_tol_arr = as_f32(float(rel_tol))
+    min_iter_arr = as_i32(min_iter)
+    lr_now = float(learning_rate)
+
+    timings: dict = {"trace": 0.0, "compile": 0.0}
+    probe_args = (params0, opt_state0, losses, diag, as_i32(i0_host),
+                  as_i32(min(i0_host + diag_every, max_iter)),
+                  min_iter_arr, rel_tol_arr, as_f32(lr_now), loss_args)
+    compiled = _resolve_program(_run_fit_chunk, "chunk", loss_fn,
+                                probe_args, {}, static_kwargs, timings)
+
+    def run_chunk(params, opt_state, losses, diag, i_host, stop_host,
+                  lr_val):
+        args = (params, opt_state, losses, diag, as_i32(i_host),
+                as_i32(stop_host), min_iter_arr, rel_tol_arr,
+                as_f32(lr_val), loss_args)
+        if compiled is not None:
+            return compiled(*args)
+        return _run_fit_chunk(loss_fn, *args, **static_kwargs)
+
+    params, opt_state = params0, opt_state0
+    i_host = i0_host
+    budget = max_iter
+    decisions: list = []
+    reseeds = extra_granted = nan_retries = 0
+    converged_flag = nan_flag = False
+    best_loss = float("inf")
+    best_params, best_it = params0, i0_host
+    prev_verdict = None
+    # iteration the current trajectory regime began at: 0 for a fresh
+    # or resumed fit (a resume continues the same trajectory), bumped
+    # by reseed / NaN retry so the stagnation stop measures the
+    # restarted segment on its own terms
+    stagnation_anchor = 0
+
+    t0 = time.perf_counter()
+    while i_host < budget:
+        chunk_entry_params, chunk_entry_it = params, i_host
+        (i, params, opt_state, losses, diag, converged,
+         is_nan) = run_chunk(params, opt_state, losses, diag, i_host,
+                             min(i_host + diag_every, budget), lr_now)
+        i_host = int(i)
+        losses_np = np.asarray(losses)
+        traj = losses_np[:i_host]
+        # best-loss checkpoint at chunk granularity: the params that
+        # ENTERED this chunk scored losses[entry_it] (computed inside
+        # the chunk from exactly those params)
+        if chunk_entry_it < i_host \
+                and np.isfinite(losses_np[chunk_entry_it]) \
+                and float(losses_np[chunk_entry_it]) < best_loss:
+            best_loss = float(losses_np[chunk_entry_it])
+            best_params, best_it = chunk_entry_params, chunk_entry_it
+        converged_flag = bool(converged)
+        nan_flag = bool(is_nan)
+
+        if nan_flag:
+            decision = _controller.decide(
+                policy, losses=traj, it=i_host, budget=budget,
+                min_iter=min_iter, nan=True,
+                nan_retries_done=nan_retries)
+            decision = dict(decision)
+            prev_verdict = None  # the retry restarts the trajectory
+            # the artifact must be self-consistent: best_params belong
+            # to iteration best_it, so the checkpoint records THAT
+            # prefix — the poisoned tail lives on FitResult.losses and
+            # the nan_abort event, not inside the restartable state
+            ckpt_path = _save_escalation_checkpoint(
+                escalate_dir, escalate_tag, best_params,
+                traj[:best_it], num_iters=best_it)
+            if ckpt_path:
+                decision["detail"] = (decision.get("detail", "")
+                                      + f"; checkpoint saved to "
+                                        f"{ckpt_path}")
+            decisions.append(decision)
+            if decision.get("outcome") != "retry":
+                break
+            nan_retries += 1
+            lr_now = lr_now * float(policy.nan_lr_factor)
+            params = best_params
+            opt_state = make_opt_state(best_params, lr_now, b1, b2)
+            # redo from the checkpointed iteration: every poisoned
+            # losses/diag entry beyond it is overwritten as the retry
+            # re-runs those iterations
+            i_host = best_it
+            stagnation_anchor = best_it
+            nan_flag = False
+            continue
+
+        if converged_flag:
+            break  # the reference's own rel-tol criterion fired
+
+        d = _decode_diag(np.asarray(diag), i_host, i0_host, diag_every)
+        grad = d["grad_norm"] if len(d["iter"]) else None
+        decision, prev_verdict = _controller.evaluate(
+            policy, losses=traj, it=i_host, budget=budget,
+            min_iter=min_iter,
+            grad_norm_first=float(grad[0]) if grad is not None else None,
+            grad_norm_last=float(grad[-1]) if grad is not None else None,
+            exhausted=i_host >= budget, reseeds_done=reseeds,
+            extra_granted=extra_granted, prev_verdict=prev_verdict,
+            stagnation_start=stagnation_anchor)
+        if decision is None:
+            continue
+        action = decision["action"]
+        if action == "early_stop":
+            # hand back the BEST state seen, not whatever the last
+            # chunk left: the noisy tails this stop fires on carry
+            # intermittent loss spikes, and stopping right after one
+            # must not cost accuracy.  Audited on the decision itself.
+            if best_loss < float(traj[-1]):
+                params = best_params
+                decision["detail"] = (
+                    f"restored the best-loss checkpoint (iter {best_it}"
+                    f", loss {best_loss:.6g}) — the final state was "
+                    f"worse (loss {float(traj[-1]):.6g})")
+            # an early stop IS the adaptive path's convergence
+            # criterion firing — mark the fit TERMINAL, so a saved
+            # checkpoint restores as completed instead of resuming a
+            # deliberately-finished fit (which would re-burn the
+            # reclaimed budget, pairing the restored best-loss params
+            # with final-iteration Adam moments and a loss prefix that
+            # matches neither)
+            converged_flag = True
+            decisions.append(decision)
+            break
+        decisions.append(decision)
+        if action == "extend":
+            grant = int(decision["iters_granted"])
+            budget += grant
+            extra_granted += grant
+        elif action == "reseed":
+            reseeds += 1
+            params = _perturb_params(best_params, policy.reseed_scale,
+                                     policy.seed, reseeds)
+            opt_state = make_opt_state(params, lr_now, b1, b2)
+            prev_verdict = None  # the perturbed trajectory is a new
+            # regime — instability must re-prove persistence, and the
+            # stagnation stop must not cancel the restart against the
+            # pre-reseed global best
+            stagnation_anchor = i_host
+
+    n = i_host
+    losses_host = np.asarray(losses)[:n]
+    diagnostics = _decode_diag(np.asarray(diag), n, i0_host, diag_every)
+    timings["fit"] = time.perf_counter() - t0
+    health = _diagnose(losses_host, converged_flag, nan_flag,
+                       diagnostics, doctor_thresholds)
+    return FitResult(
+        params=params,
+        losses=losses_host,
+        num_iters=n,
+        converged=converged_flag,
+        nan_abort=nan_flag,
+        opt_state=opt_state,
+        timings=timings,
+        diagnostics=diagnostics,
+        verdict=health["verdict"],
+        health=health,
+        decisions=decisions,
+        budget=int(budget),
+    )
+
+
+def _save_escalation_checkpoint(escalate_dir, tag, params, losses,
+                                num_iters: int) -> Optional[str]:
+    """Persist the best-loss state of a NaN-escalated fit (diagnosable
+    artifact for the post-mortem); best-effort — a failed save must not
+    mask the escalation itself."""
+    if not escalate_dir:
+        return None
+    try:
+        from scdna_replication_tools_tpu.infer import checkpoint as ckpt
+
+        params_np = jax.tree_util.tree_map(np.asarray, params)
+        return ckpt.save_step(str(escalate_dir), f"{tag}_nan", params_np,
+                              np.asarray(losses), num_iters=num_iters,
+                              converged=False, nan_abort=True)
+    except Exception as exc:  # noqa: BLE001 — telemetry-adjacent path
+        from scdna_replication_tools_tpu.utils.profiling import logger
+
+        logger.warning("NaN-escalation checkpoint save failed: %s", exc)
+        return None
 
 
 def _diagnose(losses: np.ndarray, converged: bool, nan_abort: bool,

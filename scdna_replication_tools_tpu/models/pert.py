@@ -926,6 +926,54 @@ def posterior_entropy(spec: PertModelSpec, params: dict, fixed: dict,
     return out[3], out[4]
 
 
+def entropy_aggregates_from_planes(cn_ent, rep_ent, lmask,
+                                   entropy_thresh: float,
+                                   want_max: bool = False) -> dict:
+    """Per-cell reduction of the (cells, loci) entropy planes over the
+    real (unmasked) loci — the ONE copy of the aggregate math shared by
+    :func:`cell_entropy_aggregates` (the rescue gate's standalone path)
+    and ``runner.package_step_output``'s QC table, so the controller's
+    gate signal cannot drift from the table it is documented to match.
+    """
+    denom = jnp.maximum(jnp.sum(lmask), 1.0)
+    out = {
+        "mean_cn_entropy":
+            jnp.sum(cn_ent * lmask[None, :], axis=1) / denom,
+        "frac_low_conf":
+            jnp.sum((cn_ent > entropy_thresh) * lmask[None, :],
+                    axis=1) / denom,
+        "mean_rep_entropy":
+            jnp.sum(rep_ent * lmask[None, :], axis=1) / denom,
+    }
+    if want_max:
+        out["max_cn_entropy"] = jnp.max(
+            jnp.where(lmask[None, :] > 0, cn_ent, 0.0), axis=1)
+    return out
+
+
+def cell_entropy_aggregates(spec: PertModelSpec, params: dict, fixed: dict,
+                            batch: PertBatch, entropy_thresh: float = 0.5,
+                            cell_chunk: Optional[int] = None):
+    """Per-cell posterior-confidence aggregates, reduced on device.
+
+    Returns ``(mean_cn_entropy, frac_low_conf, mean_rep_entropy)`` —
+    each ``(cells,)`` — over the real (unmasked) loci: the same
+    aggregates ``runner.package_step_output`` builds for the QC table
+    (both go through :func:`entropy_aggregates_from_planes`), but
+    available STANDALONE so the adaptive controller can gate the
+    mirror rescue on high-entropy QC signals before any packaging
+    decode has run.  Shares :func:`_decode_slab`'s compiled program
+    (want_entropy=True), so a later packaging pass with equal shapes
+    pays no second compile.
+    """
+    cn_ent, rep_ent = posterior_entropy(spec, params, fixed, batch,
+                                        cell_chunk=cell_chunk)
+    agg = entropy_aggregates_from_planes(
+        cn_ent, rep_ent, batch.effective_loci_mask(), entropy_thresh)
+    return (agg["mean_cn_entropy"], agg["frac_low_conf"],
+            agg["mean_rep_entropy"])
+
+
 def decode_discrete_hmm(spec: PertModelSpec, params: dict, fixed: dict,
                         batch: PertBatch, restart: jnp.ndarray,
                         self_prob: float,

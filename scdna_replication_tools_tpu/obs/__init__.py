@@ -28,7 +28,14 @@ See OBSERVABILITY.md at the repo root for the event reference and how
 the JSONL relates to PhaseTimer and ``tools/trace_summary.py``.
 """
 
+from scdna_replication_tools_tpu.obs.controller import (  # noqa: F401
+    ACTIONS,
+    ControllerPolicy,
+    decide,
+    evaluate,
+)
 from scdna_replication_tools_tpu.obs.doctor import (  # noqa: F401
+    MIN_TAIL_SAMPLES,
     VERDICTS,
     classify_loss_tail,
     diagnose_fit,

@@ -39,27 +39,41 @@ DEFAULT_SLOPE_TOL = 1e-4  # |relative drift across the window| below this = flat
 DEFAULT_VAR_TOL = 1e-3    # relative detrended std above this = oscillating
 DEFAULT_GRAD_RATIO = 0.1  # grad_last/grad_first below this = decayed
 
+# the absolute floor of classifiable tails: a line fit through <= 2
+# points is exact by construction (and sxx zero-divides at n=1), so any
+# tail shorter than this is ``unknown`` regardless of the caller's
+# stricter ``min_samples`` demand (the adaptive controller asks for a
+# FULL window before acting — see obs/controller.py)
+MIN_TAIL_SAMPLES = 3
+
 VERDICTS = ("converged", "plateaued", "oscillating", "diverging", "unknown")
 
 
 def tail_stats(losses: Sequence[float],
-               window: int = DEFAULT_WINDOW) -> Optional[dict]:
+               window: int = DEFAULT_WINDOW,
+               min_samples: int = MIN_TAIL_SAMPLES) -> Optional[dict]:
     """Least-squares statistics of the last ``window`` loss samples.
 
     Returns ``{finite, drift, rel_var, scale, n}`` where ``drift`` is the
     fitted linear change ACROSS the window divided by the fit's total
     improvement and ``rel_var`` the detrended residual std on the same
-    scale; None when fewer than 3 samples exist (nothing to fit).
+    scale; None when fewer than ``min_samples`` exist (nothing to fit —
+    the floor is :data:`MIN_TAIL_SAMPLES` regardless of the argument).
     Non-finite tails short-circuit to ``finite=False`` — the numbers
     would be meaningless and the verdict is already decided.
+
+    Short/partial tails are a first-class input here: the adaptive
+    controller calls this on IN-FLIGHT trajectories (0, 1, ... samples),
+    so every length down to the empty tail must return None rather than
+    index out of range or divide by zero.
     """
     vals = [float(v) for v in losses]
     tail = vals[-int(window):] if window > 0 else vals
     n = len(tail)
-    if n < 3:
-        # fewer than 3 TAIL samples (short trajectory OR window<3): a
-        # line fit through <=2 points is exact by construction — and
-        # sxx would zero-divide at n=1
+    if n < max(int(min_samples), MIN_TAIL_SAMPLES):
+        # fewer samples than the caller trusts (and never fewer than 3:
+        # a line fit through <=2 points is exact by construction — and
+        # sxx would zero-divide at n=1)
         return None
     if not all(math.isfinite(v) for v in tail):
         return {"finite": False, "drift": None, "rel_var": None,
@@ -91,14 +105,18 @@ def tail_stats(losses: Sequence[float],
 def classify_loss_tail(losses: Sequence[float],
                        window: int = DEFAULT_WINDOW,
                        slope_tol: float = DEFAULT_SLOPE_TOL,
-                       var_tol: float = DEFAULT_VAR_TOL):
+                       var_tol: float = DEFAULT_VAR_TOL,
+                       min_samples: int = MIN_TAIL_SAMPLES):
     """(verdict, stats) from the loss trajectory alone.
 
     A flat-and-quiet tail classifies ``converged`` here;
     :func:`diagnose_fit` may demote it to ``plateaued`` when gradient
-    samples show the optimiser never came to rest.
+    samples show the optimiser never came to rest.  ``min_samples``
+    raises the evidence bar: fewer tail samples than that returns
+    ``unknown`` (the controller demands a FULL window before acting on
+    a partial, in-flight trajectory).
     """
-    stats = tail_stats(losses, window=window)
+    stats = tail_stats(losses, window=window, min_samples=min_samples)
     if stats is None:
         return "unknown", None
     if not stats["finite"]:
@@ -130,7 +148,8 @@ def diagnose_fit(losses: Sequence[float],
                  window: int = DEFAULT_WINDOW,
                  slope_tol: float = DEFAULT_SLOPE_TOL,
                  var_tol: float = DEFAULT_VAR_TOL,
-                 grad_ratio: float = DEFAULT_GRAD_RATIO) -> dict:
+                 grad_ratio: float = DEFAULT_GRAD_RATIO,
+                 min_samples: int = MIN_TAIL_SAMPLES) -> dict:
     """Full fit-health verdict: loss-tail class + gradient-norm health.
 
     ``converged``/``nan_abort`` are the fit loop's own flags;
@@ -138,6 +157,11 @@ def diagnose_fit(losses: Sequence[float],
     buffer when sampling was enabled (None otherwise).  Returns a dict
     with ``verdict`` (one of :data:`VERDICTS`), a human ``reason``, the
     tail statistics, and ``grad_decay`` = last/first gradient norm.
+
+    Safe on partial, in-flight tails: any trajectory shorter than
+    ``min_samples`` (including the empty one) reads ``unknown`` — the
+    adaptive controller calls this between fit chunks and passes its
+    full window length here so it never acts on thin evidence.
     """
     grad_decay = None
     if grad_norm_first and grad_norm_last is not None \
@@ -147,7 +171,8 @@ def diagnose_fit(losses: Sequence[float],
 
     verdict, stats = classify_loss_tail(losses, window=window,
                                         slope_tol=slope_tol,
-                                        var_tol=var_tol)
+                                        var_tol=var_tol,
+                                        min_samples=min_samples)
     out = {
         "verdict": verdict,
         "reason": "",

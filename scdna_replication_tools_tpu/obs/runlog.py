@@ -37,7 +37,8 @@ from typing import Optional
 from scdna_replication_tools_tpu.utils import profiling
 from scdna_replication_tools_tpu.utils.profiling import logger
 
-SCHEMA_VERSION = 2  # v2: model-health events (fit_health, cell_qc_summary)
+SCHEMA_VERSION = 3  # v3: control_decision (adaptive fit controller);
+# v2 added the model-health events (fit_health, cell_qc_summary)
 
 
 def _json_safe(value):

@@ -69,6 +69,21 @@ def summarize_events(events: List[dict]) -> dict:
         "grad_decay": ev.get("grad_decay"),
     } for ev in _of(events, "fit_health")]
 
+    # the adaptive controller's audit trail (schema v3): one entry per
+    # decision, plus the aggregate an A/B reader wants first — how many
+    # iterations the controller reclaimed vs granted
+    control = [{
+        "step": ev.get("step"),
+        "action": ev.get("action"),
+        "iter": ev.get("iter"),
+        "budget": ev.get("budget"),
+        "trigger": ev.get("trigger"),
+        "iters_saved": ev.get("iters_saved"),
+        "iters_granted": ev.get("iters_granted"),
+        "outcome": ev.get("outcome"),
+        "detail": ev.get("detail"),
+    } for ev in _of(events, "control_decision")]
+
     fits = [{
         "step": ev.get("step"),
         "iters": ev.get("iters"),
@@ -114,6 +129,17 @@ def summarize_events(events: List[dict]) -> dict:
         },
         "fit_health": fit_health,
         "cell_qc": _of(events, "cell_qc_summary"),
+        "control_decisions": control,
+        "controller": {
+            "decisions": len(control),
+            "iters_saved": sum(int(d["iters_saved"] or 0)
+                               for d in control),
+            "iters_granted": sum(int(d["iters_granted"] or 0)
+                                 for d in control),
+            "actions": {a: sum(1 for d in control if d["action"] == a)
+                        for a in sorted({d["action"] for d in control
+                                         if d["action"]})},
+        },
         "rescues": _of(events, "rescue"),
         "nan_aborts": _of(events, "nan_abort"),
         "checkpoints": _of(events, "checkpoint"),

@@ -380,7 +380,9 @@ def normalize_by_cell(cn_s: pd.DataFrame, cn_g1: pd.DataFrame,
     # (sort_by_cell_and_loci), where non-canonical contigs become NaN and
     # then the literal string 'nan' in the gate comparisons — reproduce
     # that exactly so both engines gate and merge identically
-    chr_sorted = cat.take(perm).astype(str).to_numpy()
+    # (np.asarray, not .to_numpy(): Categorical.astype(str) returns a
+    # plain ndarray on pandas >= 2.1, a pandas array before)
+    chr_sorted = np.asarray(cat.take(perm).astype(str), dtype=object)
     start_sorted = np.asarray(start_vals)[perm]
 
     n_cells, n_cols = s_mat.shape

@@ -1,0 +1,43 @@
+"""Fixture for PL010 (unknown-control-decision-action).
+
+Parsed by the lint tests, never imported.  Lines ending in the expect
+marker must fire; the inline-disable line must land in the suppressed
+list.  Known actions come from the REAL checked-in schema
+(obs/runlog_schema.json, definitions.control_decision.action.enum) —
+'early_stop', 'extend', 'rescue_skip' are in it; 'early_stopp' and
+'panic' are not.
+"""
+
+
+def known_actions_are_clean(run_log, _runlog):
+    run_log.emit("control_decision", step="step2",
+                 action="early_stop", iter=80)          # in the enum: ok
+    run_log.emit("control_decision", step="step2",
+                 action="rescue_skip", iter=120)        # in the enum: ok
+    _runlog.current().emit("control_decision", step="step1",
+                           action="extend", iter=60)    # current(): ok
+
+
+def unknown_action_fires(run_log):
+    run_log.emit("control_decision", step="step2",
+                 action="early_stopp", iter=80)  # expect: PL010
+    run_log.emit("control_decision", step="step2",
+                 action="panic", iter=9)  # pertlint: disable=PL010
+
+
+def other_event_kinds_are_exempt(run_log):
+    # 'action' kwargs of OTHER events are a different vocabulary
+    # (checkpoint's save/load enum) — not this rule's business
+    run_log.emit("checkpoint", action="save", step="step2")
+
+
+def dynamic_action_is_exempt(run_log, decision):
+    # the runner's pass-through: action arrives inside the decision
+    # dict — non-literal, the runtime validator covers it
+    run_log.emit("control_decision", step="step2", **decision)
+    run_log.emit("control_decision", step="step2",
+                 action=decision["action"], iter=1)
+
+
+def non_runlog_receivers_are_exempt(bus):
+    bus.emit("control_decision", action="launch_missiles")

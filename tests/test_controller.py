@@ -1,0 +1,379 @@
+"""Adaptive fit controller: policy, chunked driver, audit determinism.
+
+The controller (obs/controller.py + the chunked driver in
+infer/svi.py::_fit_map_controlled) closes the observability → control
+loop: fits run as jit-compiled fixed-size chunks and between chunks the
+flight-recorder signals may early-stop / extend / re-seed / escalate.
+These tests pin the contracts that make that safe to ship default-ON:
+
+* the POLICY maps synthetic signal sets to the documented actions, with
+  the documented bounds (extension cap, reseed budget, NaN retry
+  budget) and never acts on thin evidence;
+* the chunked loop is a bit-exact twin of the single whole-budget
+  ``lax.while_loop`` when the controller never acts — the restructure
+  itself introduces no numeric drift — and ``controller=None`` is
+  literally the untouched fixed path (no decisions, same budget);
+* DETERMINISM: same seed + same config → byte-identical
+  ``control_decision`` sequences (the audit trail is reproducible);
+* NaN escalation end-to-end on a toy loss that genuinely poisons
+  itself: checkpoint artifact saved, reduced-LR retry, bounded aborts;
+* the action vocabulary is a single source of truth: ``ACTIONS`` ==
+  the schema enum (pertlint PL010 cross-checks emit sites against it).
+"""
+
+import json
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from scdna_replication_tools_tpu.infer.runner import _PertLossFn
+from scdna_replication_tools_tpu.infer.svi import fit_map
+from scdna_replication_tools_tpu.models.pert import (
+    PertBatch,
+    PertModelSpec,
+    init_params,
+)
+from scdna_replication_tools_tpu.obs import ACTIONS, ControllerPolicy, decide
+from scdna_replication_tools_tpu.obs.schema import load_schema
+from scdna_replication_tools_tpu.ops.gc import gc_features
+
+SPEC = PertModelSpec(P=5, K=2, L=1, tau_mode="param")
+
+
+def _problem(seed=0, num_cells=8, num_loci=30):
+    rng = np.random.default_rng(seed)
+    reads = rng.poisson(40, (num_cells, num_loci)).astype(np.float32)
+    gammas = rng.uniform(0.35, 0.6, num_loci).astype(np.float32)
+    etas = np.ones((num_cells, num_loci, SPEC.P), np.float32)
+    etas[:, :, 2] = 100.0
+    batch = PertBatch(
+        reads=jnp.asarray(reads),
+        libs=jnp.zeros(num_cells, jnp.int32),
+        gamma_feats=gc_features(jnp.asarray(gammas), SPEC.K),
+        mask=jnp.ones((num_cells,), jnp.float32),
+        etas=jnp.asarray(etas),
+    )
+    params0 = init_params(SPEC, batch, {},
+                          t_init=np.full(num_cells, 0.4, np.float32))
+    return params0, batch
+
+
+# ---------------------------------------------------------------------------
+# policy: synthetic signals -> documented actions
+# ---------------------------------------------------------------------------
+
+POLICY = ControllerPolicy(max_extra_iters=60, extend_step=50,
+                          stop_patience=50, stop_ftol=1e-3, window=16)
+
+
+def _floor_tail(n_descent=100, n_flat=100, noise=0.02, seed=0):
+    """Smooth descent to a floor, then a noisy-but-stagnant tail."""
+    rng = np.random.default_rng(seed)
+    return (list(np.linspace(100.0, 10.0, n_descent))
+            + list(10.0 + noise * rng.standard_normal(n_flat)))
+
+
+def test_policy_no_decision_while_descending():
+    losses = list(np.linspace(100.0, 10.0, 100))
+    assert decide(POLICY, losses=losses, it=100, budget=200,
+                  min_iter=60) is None
+
+
+def test_policy_no_decision_on_thin_evidence():
+    # below min_iter, and below a full doctor window: never act
+    assert decide(POLICY, losses=[5.0, 4.0], it=2, budget=200,
+                  min_iter=60) is None
+    assert decide(POLICY, losses=_floor_tail(), it=200, budget=400,
+                  min_iter=300) is None
+
+
+def test_policy_stagnant_floor_early_stops_with_ledger():
+    losses = _floor_tail()
+    d = decide(POLICY, losses=losses, it=200, budget=400, min_iter=60)
+    assert d["action"] == "early_stop"
+    assert d["iters_saved"] == 200
+    assert d["thresholds"]["stop_patience"] == 50
+    # the trigger snapshot must let an auditor re-derive the verdict
+    assert d["trigger"]["verdict"] == "converged"
+    assert d["trigger"]["reason"]
+
+
+def test_policy_stagnation_is_spike_robust():
+    """A transient loss spike inside the patience window must not block
+    the stop (the best-loss series is monotone), and a spike must not
+    CAUSE a stop while the fit is still genuinely improving."""
+    losses = _floor_tail(noise=0.02)
+    # catastrophic transient OUTSIDE the doctor window (so the tail
+    # reads clean) but inside the 50-iter patience horizon: a plain
+    # "no loss improvement" test would see 80.0 and refuse to stop;
+    # the monotone best-loss series does not care
+    losses[-30] = 80.0
+    d = decide(POLICY, losses=losses, it=200, budget=400, min_iter=60)
+    assert d is not None and d["action"] == "early_stop"
+
+    improving = list(np.linspace(100.0, 10.0, 200))
+    improving[-30] = 80.0
+    assert decide(POLICY, losses=improving, it=200, budget=400,
+                  min_iter=60) is None
+
+
+def test_policy_stagnation_anchor_gives_restart_runway():
+    """A reseed/NaN-retry restart begins a new trajectory regime: the
+    stagnation stop must measure only within it (stagnation_start),
+    not cancel the restart against the pre-restart global best it has
+    not yet beaten."""
+    # regime 1: descent to a 10.0 floor; regime 2 (restart at iter
+    # 200): fresh descent from the perturbed state, still above the
+    # old best — genuinely improving, but min(losses) is unchanged
+    losses = _floor_tail() + list(np.linspace(60.0, 12.0, 100))
+    # unanchored, the pre-restart best reads as 100 iters of zero
+    # improvement and stops the restarted fit
+    d = decide(POLICY, losses=losses, it=300, budget=400, min_iter=60)
+    assert d is not None and d["action"] == "early_stop"
+    # anchored at the restart, the new regime gets its full patience
+    assert decide(POLICY, losses=losses, it=300, budget=400,
+                  min_iter=60, stagnation_start=200) is None
+
+
+def test_policy_in_window_spike_neither_stops_nor_reseeds():
+    """A spike INSIDE the doctor window reads oscillating: the stop
+    triggers hold off (never stop into very-recent instability) and a
+    FIRST unstable read never re-seeds (the persistence gate) — the
+    transient costs at most one chunk of deferral."""
+    losses = _floor_tail(noise=0.02)
+    losses[-10] = 80.0
+    assert decide(POLICY, losses=losses, it=200, budget=400,
+                  min_iter=60) is None
+
+
+def test_policy_extend_only_at_exhaustion_and_capped():
+    losses = list(np.linspace(100.0, 10.0, 200))
+    kw = dict(losses=losses, it=200, budget=200, min_iter=60,
+              exhausted=True, grad_norm_first=5.0, grad_norm_last=4.0)
+    d = decide(POLICY, **kw)
+    assert d["action"] == "extend" and d["iters_granted"] == 50
+    # the grant is clipped by the remaining headroom...
+    d = decide(POLICY, extra_granted=POLICY.max_extra_iters - 10, **kw)
+    assert d["iters_granted"] == 10
+    # ...and a spent cap grants nothing
+    assert decide(POLICY, extra_granted=POLICY.max_extra_iters,
+                  **kw) is None
+
+
+def test_policy_no_extend_when_best_loss_is_stagnant():
+    """At exhaustion, a 'plateaued' tail whose BEST loss went nowhere
+    over the patience horizon is churn, not progress — no grant."""
+    losses = _floor_tail(n_descent=100, n_flat=100, noise=0.0)
+    assert decide(POLICY, losses=losses, it=200, budget=200,
+                  min_iter=60, exhausted=True, grad_norm_first=5.0,
+                  grad_norm_last=4.0) is None
+
+
+def test_policy_oscillation_reseeds_only_when_persistent():
+    """Re-seed needs oscillation on two CONSECUTIVE evaluations: the
+    first unstable read only parks the verdict (no action); the second
+    fires, and the reseed budget bounds it."""
+    from scdna_replication_tools_tpu.obs import evaluate
+
+    rng = np.random.default_rng(3)
+    base = list(np.linspace(100.0, 60.0, 100))
+    osc = base + list(60.0 + 15.0 * (-1.0) ** np.arange(60)
+                      + rng.standard_normal(60))
+    d, verdict = evaluate(POLICY, losses=osc, it=160, budget=400,
+                          min_iter=60)
+    assert d is None and verdict == "oscillating"
+    d, _ = evaluate(POLICY, losses=osc, it=160, budget=400, min_iter=60,
+                    prev_verdict=verdict)
+    assert d is not None and d["action"] == "reseed"
+    assert "consecutive" in d["detail"]
+    d, _ = evaluate(POLICY, losses=osc, it=160, budget=400, min_iter=60,
+                    prev_verdict=verdict,
+                    reseeds_done=POLICY.max_reseeds)
+    assert d is None
+
+
+def test_policy_nan_escalates_then_aborts():
+    d = decide(POLICY, losses=[1.0, float("nan")], it=2, budget=200,
+               min_iter=60, nan=True)
+    assert d["action"] == "escalate" and d["outcome"] == "retry"
+    d = decide(POLICY, losses=[1.0, float("nan")], it=2, budget=200,
+               min_iter=60, nan=True,
+               nan_retries_done=POLICY.max_nan_retries)
+    assert d["outcome"] == "abort"
+
+
+def test_actions_vocabulary_matches_schema_enum():
+    schema = load_schema()
+    enum = schema["definitions"]["control_decision"]["properties"][
+        "action"]["enum"]
+    assert set(ACTIONS) == set(enum)
+
+
+# ---------------------------------------------------------------------------
+# chunked driver: parity, determinism, audit trail
+# ---------------------------------------------------------------------------
+
+# a policy that can never act: no stagnation rule, a doctor window no
+# partial tail will ever fill, no extension headroom
+INERT = ControllerPolicy(max_extra_iters=0, stop_patience=0,
+                         window=10**6)
+
+
+def test_inert_controller_reproduces_fixed_path_bit_exactly():
+    """The chunked outer loop is a numeric no-op: with a controller
+    that never acts, trajectory AND params must equal the single
+    whole-budget ``lax.while_loop`` bit for bit."""
+    loss = _PertLossFn(spec=SPEC)
+    params_a, batch_a = _problem(seed=2)
+    fixed = fit_map(loss, params_a, ({}, batch_a), max_iter=40,
+                    min_iter=40, diag_every=10)
+    params_b, batch_b = _problem(seed=2)
+    chunked = fit_map(loss, params_b, ({}, batch_b), max_iter=40,
+                      min_iter=40, diag_every=10, controller=INERT)
+    assert chunked.decisions == []
+    assert chunked.budget == fixed.budget == 40
+    np.testing.assert_array_equal(fixed.losses, chunked.losses)
+    for k in fixed.params:
+        np.testing.assert_array_equal(np.asarray(fixed.params[k]),
+                                      np.asarray(chunked.params[k]))
+    # the ring buffer sampled the same iterations with the same values
+    np.testing.assert_array_equal(fixed.diagnostics["loss"],
+                                  chunked.diagnostics["loss"])
+
+
+def test_controller_none_is_the_fixed_path():
+    params0, batch = _problem(seed=4)
+    fit = fit_map(_PertLossFn(spec=SPEC), params0, ({}, batch),
+                  max_iter=10, min_iter=10, diag_every=5)
+    assert fit.decisions == []
+    assert fit.budget == 10
+
+
+def _eager_stop_fit(seed=5):
+    """A controlled fit configured so the stagnation stop genuinely
+    fires inside the budget (loose ftol, short patience)."""
+    policy = ControllerPolicy(max_extra_iters=0, stop_patience=10,
+                              stop_ftol=0.02, window=16)
+    params0, batch = _problem(seed=seed)
+    return fit_map(_PertLossFn(spec=SPEC), params0, ({}, batch),
+                   max_iter=120, min_iter=20, diag_every=10,
+                   controller=policy)
+
+
+def test_early_stop_reclaims_budget_and_audits():
+    fit = _eager_stop_fit()
+    assert fit.decisions, "stagnation stop never fired on this fixture"
+    last = fit.decisions[-1]
+    assert last["action"] == "early_stop"
+    assert fit.num_iters < 120
+    assert last["iters_saved"] == 120 - fit.num_iters
+    assert last["iter"] == fit.num_iters
+    # trajectory is truncated at the stop, all real samples
+    assert len(fit.losses) == fit.num_iters
+    assert np.isfinite(fit.losses).all()
+
+
+def test_decision_trail_is_byte_identical_across_reruns():
+    """Same seed + same config → the audit trail serialises to the
+    SAME bytes (the reproducibility contract of adaptive fits)."""
+    a, b = _eager_stop_fit(seed=6), _eager_stop_fit(seed=6)
+    assert json.dumps(a.decisions, sort_keys=True) \
+        == json.dumps(b.decisions, sort_keys=True)
+    np.testing.assert_array_equal(a.losses, b.losses)
+    for k in a.params:
+        np.testing.assert_array_equal(np.asarray(a.params[k]),
+                                      np.asarray(b.params[k]))
+
+
+# ---------------------------------------------------------------------------
+# NaN escalation end-to-end (toy self-poisoning loss)
+# ---------------------------------------------------------------------------
+
+
+def _poison_loss(params, ceiling):
+    # smooth descent toward x=10 that walks off a sqrt cliff at
+    # x=ceiling: past it the loss is NaN, exactly the mid-fit poisoning
+    # the escalation path exists for
+    x = params["x"]
+    return jnp.sum((x - 10.0) ** 2) + jnp.sum(jnp.sqrt(ceiling - x))
+
+
+def test_nan_escalation_checkpoints_retries_and_bounds(tmp_path):
+    params0 = {"x": jnp.zeros((4,), jnp.float32)}
+    fit = fit_map(_poison_loss, params0, (4.0,), max_iter=400,
+                  min_iter=1, learning_rate=0.5, diag_every=10,
+                  controller=ControllerPolicy(max_extra_iters=0,
+                                              stop_patience=0,
+                                              window=10**6),
+                  escalate_dir=str(tmp_path), escalate_tag="toy")
+    escalations = [d for d in fit.decisions if d["action"] == "escalate"]
+    assert escalations, "the poisoned fit never escalated"
+    assert escalations[0]["outcome"] == "retry"
+    assert "lr x 0.1" in escalations[0]["detail"]
+    # the diagnosable artifact exists and carries a finite best state
+    ckpt = tmp_path / "pert_toy_nan.npz"
+    assert ckpt.exists()
+    assert str(ckpt) in escalations[0]["detail"]
+    saved = np.load(ckpt)
+    assert np.isfinite(saved["param.x"]).all()
+    # retries are bounded: at most max_nan_retries retry outcomes, and
+    # a second escalation (if any) aborts
+    outcomes = [d["outcome"] for d in escalations]
+    assert outcomes.count("retry") <= 1
+    if len(escalations) > 1:
+        assert outcomes[-1] == "abort"
+        assert fit.nan_abort
+
+
+def test_nan_escalation_is_deterministic(tmp_path):
+    runs = []
+    for sub in ("a", "b"):
+        params0 = {"x": jnp.zeros((4,), jnp.float32)}
+        fit = fit_map(_poison_loss, params0, (4.0,), max_iter=400,
+                      min_iter=1, learning_rate=0.5, diag_every=10,
+                      controller=ControllerPolicy(max_extra_iters=0,
+                                                  stop_patience=0,
+                                                  window=10**6),
+                      escalate_dir=str(tmp_path / sub),
+                      escalate_tag="toy")
+        # strip the checkpoint path (varies with tmp dir by design)
+        trail = [{k: v for k, v in d.items() if k != "detail"}
+                 for d in fit.decisions]
+        runs.append(json.dumps(trail, sort_keys=True))
+    assert runs[0] == runs[1]
+
+
+# ---------------------------------------------------------------------------
+# reseed mechanism (driver level)
+# ---------------------------------------------------------------------------
+
+
+def test_perturb_params_is_deterministic_and_small():
+    from scdna_replication_tools_tpu.infer.svi import _perturb_params
+
+    params = {"a": jnp.ones((8,), jnp.float32),
+              "b": jnp.linspace(-2.0, 2.0, 16).astype(jnp.float32)}
+    p1 = _perturb_params(params, 0.02, seed=7, salt=1)
+    p2 = _perturb_params(params, 0.02, seed=7, salt=1)
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(p1[k]),
+                                      np.asarray(p2[k]))
+        # perturbed, but on the scale of the leaf spread, not beyond
+        assert not np.array_equal(np.asarray(p1[k]),
+                                  np.asarray(params[k]))
+        assert np.max(np.abs(np.asarray(p1[k]) - np.asarray(params[k]))) \
+            < 1.0
+    p3 = _perturb_params(params, 0.02, seed=7, salt=2)
+    assert not np.array_equal(np.asarray(p3["a"]), np.asarray(p1["a"]))
+
+
+def test_controller_requires_diag_cadence():
+    """controller without a flight recorder (diag_every=0) falls back
+    to the fixed path rather than acting blind."""
+    params0, batch = _problem(seed=8)
+    fit = fit_map(_PertLossFn(spec=SPEC), params0, ({}, batch),
+                  max_iter=10, min_iter=10, diag_every=0,
+                  controller=POLICY)
+    assert fit.decisions == []
+    assert fit.num_iters == 10

@@ -47,6 +47,7 @@ from scdna_replication_tools_tpu.obs import (
     summarize_events,
     validate_run,
 )
+from scdna_replication_tools_tpu.obs.doctor import tail_stats
 from scdna_replication_tools_tpu.obs.schema import load_schema
 from scdna_replication_tools_tpu.ops.gc import gc_features
 
@@ -110,6 +111,41 @@ def test_doctor_too_few_samples_is_unknown():
     report = diagnose_fit([1.0])
     assert report["verdict"] == "unknown"
     assert "too few" in report["reason"]
+
+
+@pytest.mark.parametrize("n", [0, 1])
+def test_doctor_degenerate_tails_are_unknown(n):
+    """The adaptive controller feeds IN-FLIGHT partial trajectories to
+    the doctor; the empty and single-sample tails must read unknown,
+    never index out of range or divide by zero (sxx is 0 at n=1)."""
+    losses = [1000.0] * n
+    assert tail_stats(losses) is None
+    assert classify_loss_tail(losses)[0] == "unknown"
+    report = diagnose_fit(losses)
+    assert report["verdict"] == "unknown"
+    # and the same with gradient evidence present — grad health alone
+    # must not invent a verdict out of a signal-free tail
+    report = diagnose_fit(losses, grad_norm_first=100.0,
+                          grad_norm_last=1.0)
+    assert report["verdict"] == "unknown"
+
+
+def test_doctor_min_samples_raises_the_evidence_bar():
+    """K-1 samples under a demanded min_samples=K read unknown; the
+    full K flip to a real verdict (the controller passes its window
+    length so it never acts on a part-filled window)."""
+    K = 16
+    flat = [1000.0] * (K - 1)
+    assert tail_stats(flat, window=K, min_samples=K) is None
+    assert classify_loss_tail(flat, window=K, min_samples=K)[0] \
+        == "unknown"
+    assert diagnose_fit(flat, window=K, min_samples=K)["verdict"] \
+        == "unknown"
+    full = np.r_[np.linspace(2000.0, 1000.0, 40), [1000.0] * K]
+    assert diagnose_fit(full, window=K, min_samples=K)["verdict"] \
+        == "converged"
+    # min_samples below the absolute floor is clamped, not honoured
+    assert tail_stats([1.0, 2.0], min_samples=0) is None
 
 
 def test_doctor_grad_norm_demotes_flat_to_plateaued():

@@ -43,6 +43,7 @@ FIXTURES = {
     "PL007": FIXTURE_DIR / "pl007_donate.py",
     "PL008": FIXTURE_DIR / "pl008_print.py",
     "PL009": FIXTURE_DIR / "pl009_event_kinds.py",
+    "PL010": FIXTURE_DIR / "pl010_control_actions.py",
 }
 
 
@@ -187,6 +188,9 @@ def _seed_violation(rule_id):
         "PL008": "\ndef seeded(x):\n    print(x)\n    return x\n",
         "PL009": ("\ndef seeded(run_log):\n"
                   "    run_log.emit('bogus_event_kind')\n"),
+        "PL010": ("\ndef seeded(run_log):\n"
+                  "    run_log.emit('control_decision', "
+                  "action='bogus_action', iter=1)\n"),
     }[rule_id]
 
 

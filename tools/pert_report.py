@@ -212,6 +212,49 @@ def _model_health_section(fit_health: list, cell_qc: list) -> list:
     return lines
 
 
+def _decision_trail_section(control: list, agg: dict) -> list:
+    """The adaptive fit controller's audit trail (schema v3
+    ``control_decision`` events): what the controller saw, what it did,
+    and the iteration ledger — the section that makes adaptive fits
+    reproducible from the artifact alone."""
+    lines = ["## Decision trail", ""]
+    if not control:
+        return lines + ["_no control_decision events (controller off, "
+                        "inert, or a pre-v3 run log)_", ""]
+    saved = agg.get("iters_saved", 0)
+    granted = agg.get("iters_granted", 0)
+    actions = agg.get("actions") or {}
+    lines += [
+        f"- **decisions**: {len(control)} ("
+        + ", ".join(f"{k}: {v}" for k, v in actions.items()) + ")",
+        f"- **iterations reclaimed (early stops)**: {saved}",
+        f"- **iterations granted (extensions)**: {granted}",
+        "",
+        "| step | iter | action | verdict | drift | rel var | "
+        "grad decay | saved/granted | detail |",
+        "|---|---:|---|---|---:|---:|---:|---:|---|",
+    ]
+    num = (lambda v: "-" if v is None else f"{v:.3g}")
+    for d in control:
+        trig = d.get("trigger") or {}
+        ledger = "-"
+        if d.get("iters_saved") is not None:
+            ledger = f"-{d['iters_saved']}"
+        elif d.get("iters_granted") is not None:
+            ledger = f"+{d['iters_granted']}"
+        detail = d.get("detail") or d.get("outcome") or ""
+        reason = trig.get("reason") or ""
+        lines.append(
+            f"| {d.get('step')} | {d.get('iter')} "
+            f"| **{d.get('action')}** "
+            f"| {trig.get('verdict') or '-'} "
+            f"| {num(trig.get('drift'))} | {num(trig.get('rel_var'))} "
+            f"| {num(trig.get('grad_decay'))} | {ledger} "
+            f"| {detail or reason} |")
+    lines.append("")
+    return lines
+
+
 def _rescue_section(rescues: list) -> list:
     lines = ["## Mirror rescue", ""]
     if not rescues:
@@ -254,6 +297,8 @@ def render_report(path) -> str:
     lines += _fit_table(summary["fits"])
     lines += _model_health_section(summary.get("fit_health", []),
                                    summary.get("cell_qc", []))
+    lines += _decision_trail_section(summary.get("control_decisions", []),
+                                     summary.get("controller", {}))
     lines += _compile_section(summary["compile"])
     lines += _rescue_section(summary["rescues"])
     lines += _nan_section(summary["nan_aborts"])

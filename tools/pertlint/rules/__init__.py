@@ -1,6 +1,7 @@
 """Rule modules — importing this package registers every rule."""
 
 from tools.pertlint.rules import (  # noqa: F401
+    control_actions,
     donate,
     dtype_drift,
     event_kinds,

@@ -1,0 +1,103 @@
+"""PL010: control_decision actions must exist in the schema's enum.
+
+The adaptive fit controller's audit trail is only trustworthy if every
+``control_decision`` event validates against the checked-in schema
+(``obs/runlog_schema.json``), and the field that carries the decision —
+``action`` — is an enum there.  PL009 already guarantees the event KIND
+is registered; this rule closes the remaining gap for the payload: an
+``emit("control_decision", action="<literal>", ...)`` call site whose
+action literal is missing from the enum writes events that fail schema
+validation, but only when a run actually takes that decision path —
+exactly the rarely-exercised branches (NaN escalation, re-seed) where a
+rot would hide longest.  Same static AST cross-check pattern as PL009.
+
+Precision contract:
+
+* only ``.emit("control_decision", ...)`` attribute calls on a
+  recognisable RunLog receiver fire (the PL009 receiver heuristic:
+  names/attributes containing ``log``, the ``current()`` accessor, or
+  ``self`` inside a ``*Log*`` class);
+* only a LITERAL ``action=`` keyword is checked — a non-literal action
+  (``action=decision["action"]``, the runner's pass-through) cannot be
+  checked statically and is left to the runtime validator;
+* emit calls for other event kinds never fire this rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import json
+from typing import FrozenSet, Iterable, Optional
+
+from tools.pertlint.core import Finding, Rule, register
+from tools.pertlint.rules.event_kinds import (
+    _SCHEMA_PATH,
+    _is_runlog_receiver,
+)
+
+
+@functools.lru_cache(maxsize=1)
+def schema_control_actions() -> FrozenSet[str]:
+    """The control_decision.action enum pinned by the checked-in schema;
+    empty when unreadable (the rule then stays silent — a missing schema
+    is the schema tests' problem, not a lint crash)."""
+    try:
+        doc = json.loads(_SCHEMA_PATH.read_text())
+        enum = doc["definitions"]["control_decision"]["properties"][
+            "action"]["enum"]
+        return frozenset(enum)
+    except (OSError, KeyError, TypeError, ValueError):
+        return frozenset()
+
+
+@register
+class UnknownControlDecisionAction(Rule):
+    id = "PL010"
+    name = "unknown-control-decision-action"
+    severity = "error"
+    description = ("RunLog .emit('control_decision', action='<literal>') "
+                   "call site whose action literal is not in the "
+                   "control_decision action enum of "
+                   "obs/runlog_schema.json — the emitted events fail "
+                   "schema validation; register the action in the enum "
+                   "(and obs.controller.ACTIONS) first")
+
+    def __init__(self, actions: Optional[Iterable[str]] = None):
+        # injectable for tests; default = the checked-in schema enum
+        self._actions = (schema_control_actions() if actions is None
+                         else frozenset(actions))
+
+    def check(self, ctx) -> Iterable[Finding]:
+        if not self._actions:
+            return
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "emit"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and node.args[0].value == "control_decision"):
+                continue
+            if not _is_runlog_receiver(node.func.value, node, ctx):
+                continue
+            for kw in node.keywords:
+                if kw.arg != "action":
+                    continue
+                if not (isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, str)):
+                    continue  # non-literal: runtime validator's job
+                action = kw.value.value
+                if action not in self._actions:
+                    # anchor to the literal itself, not the (possibly
+                    # multi-line) call head: the expect/suppress comment
+                    # conventions are line-scoped
+                    yield self.finding(
+                        ctx, kw.value,
+                        f"control_decision action {action!r} is not in "
+                        f"the action enum of obs/runlog_schema.json — "
+                        f"emitted events will fail schema validation; "
+                        f"add the action to the schema enum and "
+                        f"obs.controller.ACTIONS (and bump "
+                        f"SCHEMA_VERSION if the vocabulary changes "
+                        f"meaning)")
