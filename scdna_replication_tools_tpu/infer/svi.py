@@ -149,6 +149,20 @@ def _fit_loop(loss_fn: Callable, lr, b1: float, b2: float,
     return i, params, opt_state, losses, diag, converged, is_nan
 
 
+# Declared jit contracts of the two fit programs, in ONE place: the
+# decorators below consume these tuples and the deep static-analysis
+# layer (tools/pertlint/deep) reads the SAME tuples to audit the lowered
+# programs — `donate_argnames` that fail to produce a real
+# input_output_alias in the lowered module are exactly the PR-4
+# mirror-rescue aliasing bug class, and a drifted copy of this list in
+# the lint layer would make that audit lie.
+FIT_STATIC_ARGNAMES = ("loss_fn", "max_iter", "min_iter", "lr", "b1", "b2",
+                       "diag_every")
+FIT_DONATE_ARGNAMES = ("params0", "opt_state0", "losses0", "diag0")
+CHUNK_STATIC_ARGNAMES = ("loss_fn", "conv_window", "b1", "b2", "diag_every")
+CHUNK_DONATE_ARGNAMES = ("opt_state0", "losses0", "diag0")
+
+
 # params0 / opt_state0 / losses0 / diag0 are initial-value pytrees, dead
 # the moment the loop consumes them — donating them lets XLA reuse their
 # buffers for the loop carry instead of copying on entry (at the
@@ -157,10 +171,8 @@ def _fit_loop(loss_fn: Callable, lr, b1: float, b2: float,
 # resume stays bit-exact: donation recycles buffers, it never changes
 # values, and every caller builds these pytrees fresh per fit (pinned by
 # tests/test_donation.py).
-@functools.partial(jax.jit, static_argnames=("loss_fn", "max_iter", "min_iter",
-                                             "lr", "b1", "b2", "diag_every"),
-                   donate_argnames=("params0", "opt_state0", "losses0",
-                                    "diag0"))
+@functools.partial(jax.jit, static_argnames=FIT_STATIC_ARGNAMES,
+                   donate_argnames=FIT_DONATE_ARGNAMES)
 def _run_fit(loss_fn: Callable, params0: dict, opt_state0, losses0, diag0,
              i0, loss_args: tuple,
              max_iter: int, min_iter: int, rel_tol: float,
@@ -185,9 +197,8 @@ def _run_fit(loss_fn: Callable, params0: dict, opt_state0, losses0, diag0,
 # best-loss checkpoint the re-seed and NaN-escalation actions restart
 # from (one extra live params copy — documented in PERF_NOTES).  The
 # consumed-on-entry carries (opt/losses/diag) are still donated.
-@functools.partial(jax.jit, static_argnames=("loss_fn", "conv_window",
-                                             "b1", "b2", "diag_every"),
-                   donate_argnames=("opt_state0", "losses0", "diag0"))
+@functools.partial(jax.jit, static_argnames=CHUNK_STATIC_ARGNAMES,
+                   donate_argnames=CHUNK_DONATE_ARGNAMES)
 def _run_fit_chunk(loss_fn: Callable, params0: dict, opt_state0, losses0,
                    diag0, i0, stop, min_iter, rel_tol, lr,
                    loss_args: tuple,

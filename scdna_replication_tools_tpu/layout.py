@@ -28,7 +28,8 @@ mesh placement (``parallel.mesh``) and the ``shard_map`` call sites
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+import dataclasses
+from typing import List, Optional, Tuple
 
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
@@ -139,3 +140,120 @@ def fused_sparse_shard_specs(mesh: Mesh):
     in_specs = (bins, bins, state_major_spec(cells, lx), bins, bins, bins,
                 P())
     return in_specs, bins
+
+
+# ---------------------------------------------------------------------------
+# machine-readable contract
+# ---------------------------------------------------------------------------
+#
+# Every PartitionSpec factory above, paired with the SYMBOLIC shape of
+# the tensor it places — "cells"/"loci"/"P"/"K1"/"L" name the logical
+# dims (K1 = K+1 GC-polynomial features).  ``contract_entries`` is what
+# turns this module's "single owner of the tensor-layout contract"
+# docstring into a machine-checked invariant: the deep lint layer
+# (tools/pertlint/deep, rules DP006/DP007) enumerates the entries
+# against a mesh's axis names/extents and canonical array ranks, so a
+# spec whose rank overflows its tensor, names an unknown mesh axis,
+# reuses an axis, or shards an indivisible dim fails CI before any
+# device sees it.
+
+_BATCH_DIMS = {
+    "reads": ("cells", "loci"),
+    "libs": ("cells",),
+    "gamma_feats": ("loci", "K1"),
+    "mask": ("cells",),
+    "etas": ("cells", "loci", "P"),
+    "eta_idx": ("cells", "loci"),
+    "eta_w": ("cells", "loci"),
+    "cn_obs": ("cells", "loci"),
+    "rep_obs": ("cells", "loci"),
+    "t_alpha": ("cells",),
+    "t_beta": ("cells",),
+    "loci_mask": ("loci",),
+}
+
+_PARAM_DIMS = {
+    "a_raw": (),
+    "lamb_raw": (),
+    "beta_means": ("L", "K1"),
+    "beta_stds_raw": ("L", "K1"),
+    "rho_raw": ("loci",),
+    "tau_raw": ("cells",),
+    "u": ("cells",),
+    "betas": ("cells", "K1"),
+    "pi_logits": ("P", "cells", "loci"),
+}
+
+# the shard_map kernel factories: (factory, in-tensor names, out name);
+# dims of each operand, in the factory's documented operand order
+_SHARD_MAP_DIMS = {
+    "enum_shard_specs": (
+        ("reads", "mu", "log_pi", "phi", "lamb"),
+        (("cells", "loci"), ("cells", "loci"), ("cells", "loci", "P"),
+         ("cells", "loci"), ()),
+        ("cells", "loci"),
+    ),
+    "fused_shard_specs": (
+        ("reads", "mu", "pi_logits_t", "phi", "etas_t", "lamb"),
+        (("cells", "loci"), ("cells", "loci"), ("P", "cells", "loci"),
+         ("cells", "loci"), ("P", "cells", "loci"), ()),
+        ("cells", "loci"),
+    ),
+    "fused_sparse_shard_specs": (
+        ("reads", "mu", "pi_logits_t", "phi", "eta_idx", "eta_w", "lamb"),
+        (("cells", "loci"), ("cells", "loci"), ("P", "cells", "loci"),
+         ("cells", "loci"), ("cells", "loci"), ("cells", "loci"), ()),
+        ("cells", "loci"),
+    ),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ContractEntry:
+    """One (tensor, spec, symbolic shape) row of the layout contract."""
+
+    tensor: str                        # "batch.reads" / "param.pi_logits"
+    factory: str                       # layout function that built the spec
+    spec: P
+    dims: Tuple[Optional[str], ...]    # symbolic logical shape
+
+
+def contract_entries(mesh) -> List[ContractEntry]:
+    """Every PartitionSpec this module can produce for ``mesh``, with
+    the symbolic shape of the tensor each spec applies to.
+
+    ``mesh`` may be a real ``jax.sharding.Mesh`` or an ``AbstractMesh``
+    — only its ``axis_names`` are consulted (the checker reads extents
+    separately).  Raises if a spec factory gains a tensor this table
+    does not declare, so the contract cannot silently under-cover.
+    """
+    _, lx = mesh_axes(mesh)
+    entries: List[ContractEntry] = []
+
+    for name, spec in batch_specs(lx).items():
+        if name not in _BATCH_DIMS:
+            raise KeyError(f"batch_specs() produced {name!r} but "
+                           f"layout._BATCH_DIMS does not declare its shape")
+        entries.append(ContractEntry(f"batch.{name}", "batch_specs", spec,
+                                     _BATCH_DIMS[name]))
+    for name, spec in param_specs(lx).items():
+        if name not in _PARAM_DIMS:
+            raise KeyError(f"param_specs() produced {name!r} but "
+                           f"layout._PARAM_DIMS does not declare its shape")
+        entries.append(ContractEntry(f"param.{name}", "param_specs", spec,
+                                     _PARAM_DIMS[name]))
+
+    for factory in (enum_shard_specs, fused_shard_specs,
+                    fused_sparse_shard_specs):
+        names, in_dims, out_dims = _SHARD_MAP_DIMS[factory.__name__]
+        in_specs, out_spec = factory(mesh)
+        if len(in_specs) != len(names):
+            raise ValueError(f"{factory.__name__} produced "
+                             f"{len(in_specs)} in_specs but the contract "
+                             f"table declares {len(names)} operands")
+        for name, spec, dims in zip(names, in_specs, in_dims):
+            entries.append(ContractEntry(f"{factory.__name__}.{name}",
+                                         factory.__name__, spec, dims))
+        entries.append(ContractEntry(f"{factory.__name__}.out",
+                                     factory.__name__, out_spec, out_dims))
+    return entries

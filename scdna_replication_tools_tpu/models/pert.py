@@ -167,6 +167,40 @@ class PertBatch:
     def tree_unflatten(cls, aux, children):
         return cls(*children)
 
+    @classmethod
+    def abstract(cls, spec: "PertModelSpec", num_cells: int,
+                 num_loci: int) -> "PertBatch":
+        """ShapeDtypeStruct-filled batch with the runner's production
+        shapes — no data is materialised, so the deep static-analysis
+        layer (tools/pertlint/deep) and shape-golden tests can trace the
+        jit entry points (``jax.eval_shape`` / ``.trace()`` / ``.lower()``)
+        on any geometry without touching a device.  Field presence
+        follows ``spec`` the way the runner populates a real batch:
+        dense ``etas`` or the sparse (eta_idx, eta_w) planes, step-1
+        conditioning planes, and the tau Beta-prior vectors.
+        """
+        import jax
+
+        f32 = jnp.float32
+        S = jax.ShapeDtypeStruct
+        bins = (num_cells, num_loci)
+        kwargs = dict(
+            reads=S(bins, f32),
+            libs=S((num_cells,), jnp.int32),
+            gamma_feats=S((num_loci, spec.K + 1), f32),
+            mask=S((num_cells,), f32),
+        )
+        if spec.step1:
+            kwargs.update(cn_obs=S(bins, f32), rep_obs=S(bins, f32))
+        elif spec.sparse_etas:
+            kwargs.update(eta_idx=S(bins, f32), eta_w=S(bins, f32))
+        else:
+            kwargs.update(etas=S(bins + (spec.P,), f32))
+        if spec.tau_mode == "beta_prior":
+            kwargs.update(t_alpha=S((num_cells,), f32),
+                          t_beta=S((num_cells,), f32))
+        return cls(**kwargs)
+
 
 jax.tree_util.register_pytree_node(
     PertBatch, PertBatch.tree_flatten, PertBatch.tree_unflatten

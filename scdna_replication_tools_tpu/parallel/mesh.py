@@ -73,6 +73,32 @@ def make_mesh(num_devices: Optional[int] = None, devices=None,
     return Mesh(grid, (CELLS_AXIS, LOCI_AXIS))
 
 
+def abstract_mesh(num_cell_shards: int = 4, loci_shards: int = 2):
+    """Device-free stand-in mesh with the canonical PERT axis names.
+
+    A ``jax.sharding.AbstractMesh`` carries axis names and extents but
+    no device assignment, so the layout-contract checker
+    (tools/pertlint/deep, DP006/DP007) and shape-math tests can validate
+    every PartitionSpec against a 4x2 cells-x-loci topology on a
+    single-device CPU — no ``XLA_FLAGS`` device forcing, no backend
+    initialisation.  The default extents mirror the MULTICHIP dryrun's
+    parity mesh.
+    """
+    from jax.sharding import AbstractMesh
+
+    if loci_shards == 1:
+        names, sizes = (CELLS_AXIS,), (num_cell_shards,)
+    else:
+        names = (CELLS_AXIS, LOCI_AXIS)
+        sizes = (num_cell_shards, loci_shards)
+    try:
+        # jax < 0.6: AbstractMesh(shape_tuple of (name, size) pairs)
+        return AbstractMesh(tuple(zip(names, sizes)))
+    except TypeError:
+        # jax >= 0.6: AbstractMesh(axis_sizes, axis_names)
+        return AbstractMesh(sizes, names)
+
+
 def loci_axis(mesh: Mesh) -> Optional[str]:
     """'loci' when the mesh shards the loci axis, else None."""
     return LOCI_AXIS if LOCI_AXIS in mesh.axis_names else None

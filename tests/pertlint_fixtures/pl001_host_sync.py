@@ -29,6 +29,21 @@ def with_statics(x, n):
     return x * int(n)                   # static_argnames: exempt
 
 
+@functools.partial(jax.jit, static_argnums=(1,))
+def with_static_nums(x, m):
+    # m is positionally static (argnum 1): a host conversion of it is a
+    # Python-level operation, exactly like the static_argnames case
+    return x * float(m)                 # static_argnums: exempt
+
+
+def nums_wrapped(x, k, t):
+    # call-site wrapping below marks k (argnum 1) static; t stays traced
+    return x * int(k) + float(t)  # expect: PL001
+
+
+nums_entry = jax.jit(nums_wrapped, static_argnums=1)
+
+
 def host_side(x):
     # not reachable from any jit entry: host code may sync freely
     return float(np.asarray(x).mean())

@@ -1,0 +1,82 @@
+"""Golden-jaxpr regression: the hot programs' traced structure is pinned.
+
+The decode slab and the fit chunk are the two programs whose semantic
+drift costs the most (the decode feeds every QC/packaging surface; the
+chunk program is ONE compiled body reused for every controller chunk of
+every fit).  This test snapshots each program's **primitive multiset**
+and **dtype census** — order-free, so a legitimate reordering or
+re-fusion of the same math never trips it, while a new host callback, a
+dtype promotion, an extra transpose or a lost while-loop fails loudly.
+
+The snapshot records the jax version it was generated under; a
+different installed jax (CI's floating pin) skips rather than chasing
+upstream lowering details.  Regenerate after an INTENDED change with:
+
+    PERT_UPDATE_GOLDEN=1 python -m pytest tests/test_jaxpr_golden.py
+"""
+
+import json
+import os
+import pathlib
+import sys
+
+import pytest
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO_ROOT))
+
+import jax  # noqa: E402
+
+from tools.pertlint.deep import entrypoints, trace  # noqa: E402
+
+GOLDEN = pathlib.Path(__file__).parent / "golden" / "jaxpr_census.json"
+PROGRAMS = ("decode_slab", "fit_chunk")
+
+
+def _census(name: str) -> dict:
+    prog = entrypoints.REGISTRY[name]()
+    ctx = trace.build_program_context(prog)
+    dtypes: dict = {}
+    for aval in ctx.var_avals:
+        dtypes[aval.dtype] = dtypes.get(aval.dtype, 0) + 1
+    return {
+        "primitives": {p.name: p.count for p in ctx.primitives},
+        "dtypes": dtypes,
+        "num_consts": len(ctx.consts),
+        "num_outputs": len(ctx.out_avals),
+    }
+
+
+def _current() -> dict:
+    return {"jax_version": jax.__version__,
+            "programs": {name: _census(name) for name in PROGRAMS}}
+
+
+def test_golden_jaxpr_census():
+    current = _current()
+    if os.environ.get("PERT_UPDATE_GOLDEN"):
+        GOLDEN.parent.mkdir(parents=True, exist_ok=True)
+        GOLDEN.write_text(json.dumps(current, indent=1, sort_keys=True)
+                          + "\n")
+        pytest.skip(f"golden snapshot regenerated at {GOLDEN}")
+    assert GOLDEN.is_file(), \
+        f"no golden snapshot — run PERT_UPDATE_GOLDEN=1 pytest {__file__}"
+    golden = json.loads(GOLDEN.read_text())
+    if golden["jax_version"] != jax.__version__:
+        pytest.skip(f"snapshot from jax {golden['jax_version']}, running "
+                    f"{jax.__version__} — lowering details differ across "
+                    f"versions; regenerate to re-pin")
+    for name in PROGRAMS:
+        want, got = golden["programs"][name], current["programs"][name]
+        # compare per-key so the failure names the drifted primitive
+        # instead of dumping two 60-entry dicts
+        assert set(want["primitives"]) == set(got["primitives"]), (
+            name, "primitive set drift",
+            set(want["primitives"]) ^ set(got["primitives"]))
+        diffs = {p: (c, got["primitives"][p])
+                 for p, c in want["primitives"].items()
+                 if got["primitives"][p] != c}
+        assert not diffs, (name, "primitive count drift", diffs)
+        assert want["dtypes"] == got["dtypes"], (name, "dtype census drift")
+        assert want["num_consts"] == got["num_consts"], (name, "consts")
+        assert want["num_outputs"] == got["num_outputs"], (name, "outputs")

@@ -282,6 +282,113 @@ def test_cli_select_and_json_format(tmp_path, capsys):
     assert payload["files_checked"] == 1
 
 
+def test_cli_github_format(tmp_path, capsys):
+    """--format=github renders findings as workflow annotations (and
+    only changes the rendering — the exit code still gates)."""
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("import numpy as np\n"
+                     "def f(n):\n    return np.random.rand(n)\n")
+    assert cli_main([str(dirty), "--no-baseline", "--format",
+                     "github"]) == 1
+    out = capsys.readouterr().out
+    assert f"::error file={dirty.as_posix()},line=3,col=12," in out
+    assert "title=pertlint PL005::" in out
+
+
+def test_cli_list_rules_includes_deep_layer(capsys):
+    assert cli_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert "PL001" in out and "DP003" in out and "DP006" in out
+
+
+def test_update_baseline_prunes_stale_and_dead_entries(tmp_path, capsys):
+    """--update-baseline drops entries whose finding is gone (stale) or
+    whose file is gone (dead), keeps live ones, and NEVER grandfathers
+    a new violation."""
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    body = "import numpy as np\ndef f(n):\n    return np.random.rand(n)\n"
+    a.write_text(body)
+    b.write_text(body.replace("f(", "g("))
+    baseline = tmp_path / "baseline.json"
+    assert snapshot_baseline([str(a), str(b)], baseline) == 2
+
+    a.write_text("def f(n):\n    return n\n")   # fixed: entry goes stale
+    b.unlink()                                   # deleted: entry goes dead
+    rc = cli_main([str(a), "--baseline", str(baseline),
+                   "--update-baseline"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "2 stale/dead entries pruned" in out
+    assert json.loads(baseline.read_text())["findings"] == []
+
+    # prune-only: a fresh violation still gates after an update
+    a.write_text(body)
+    assert cli_main([str(a), "--baseline", str(baseline),
+                     "--update-baseline"]) == 0
+    assert cli_main([str(a), "--baseline", str(baseline)]) == 1
+
+
+def test_cli_warns_on_stale_and_missing_baseline_entries(tmp_path, capsys):
+    target = tmp_path / "mod.py"
+    target.write_text("import numpy as np\n"
+                      "def f(n):\n    return np.random.rand(n)\n")
+    baseline = tmp_path / "baseline.json"
+    snapshot_baseline([str(target)], baseline)
+    target.write_text("def f(n):\n    return n\n")
+    assert cli_main([str(target), "--baseline", str(baseline)]) == 0
+    err = capsys.readouterr().err
+    assert "warning" in err and "stale" in err
+
+    # point the entry at a path that no longer exists
+    data = json.loads(baseline.read_text())
+    data["findings"][0]["path"] = str(tmp_path / "gone.py")
+    baseline.write_text(json.dumps(data))
+    assert cli_main([str(target), "--baseline", str(baseline)]) == 0
+    err = capsys.readouterr().err
+    assert "missing file" in err
+
+
+def test_update_baseline_prunes_program_scoped_deep_entries(tmp_path):
+    """Deep (DP) entries are program-scoped, not path-scoped: when the
+    deep rules ran and no longer produce an entry's fingerprint, it is
+    pruned even with no lint paths given (``--deep --update-baseline``)
+    — while a still-produced one survives, rationale intact."""
+    from tools.pertlint.engine import update_baseline
+
+    target = tmp_path / "svi.py"
+    target.write_text("def fit():\n    return 1\n")
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps({"version": 1, "findings": [
+        {"rule": "DP003", "path": str(target), "line": 1,
+         "fingerprint": "feedfacefeedface", "message": "gone"},
+        {"rule": "DP003", "path": str(target), "line": 1,
+         "fingerprint": "cafef00dcafef00d", "message": "alive",
+         "rationale": "deliberate"},
+    ]}))
+    kept, pruned = update_baseline(
+        [], baseline, extra_produced={"cafef00dcafef00d"},
+        extra_rule_ids={"DP003"})
+    assert (kept, pruned) == (1, 1)
+    entry = json.loads(baseline.read_text())["findings"][0]
+    assert entry["fingerprint"] == "cafef00dcafef00d"
+    assert entry["rationale"] == "deliberate"
+
+
+def test_write_baseline_preserves_rationales(tmp_path):
+    target = tmp_path / "mod.py"
+    target.write_text("import numpy as np\n"
+                      "def f(n):\n    return np.random.rand(n)\n")
+    baseline = tmp_path / "baseline.json"
+    snapshot_baseline([str(target)], baseline)
+    data = json.loads(baseline.read_text())
+    data["findings"][0]["rationale"] = "legacy RNG, scheduled for PR 9"
+    baseline.write_text(json.dumps(data))
+    snapshot_baseline([str(target)], baseline)  # regenerate
+    entry = json.loads(baseline.read_text())["findings"][0]
+    assert entry["rationale"] == "legacy RNG, scheduled for PR 9"
+
+
 def test_cli_parse_error_exits_2(tmp_path, capsys):
     bad = tmp_path / "bad.py"
     bad.write_text("def f(:\n")

@@ -1,0 +1,328 @@
+"""pertlint-deep: the jaxpr/sharding analysis layer.
+
+Three strata:
+
+* pure-unit — DP005/DP006/DP007 verdicts on hand-built contexts (no
+  tracing), one test per sharding-contract failure mode;
+* traced-unit — each jaxpr rule catching a deliberately-broken synthetic
+  program (the DP003 case is shaped like the PR-4 mirror-rescue
+  aliasing bug: a donated buffer the lowering could not alias);
+* the gate — the real registry traces every entry point and
+  ``python -m tools.pertlint --deep`` exits 0 on HEAD with zero
+  unbaselined findings, every baselined deep finding carrying a
+  rationale.
+"""
+
+import functools
+import json
+import pathlib
+import subprocess
+import sys
+import warnings
+
+import pytest
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO_ROOT))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from tools.pertlint.deep import entrypoints, trace  # noqa: E402
+from tools.pertlint.deep.engine import deep_lint, run_deep_rules  # noqa: E402
+from tools.pertlint.deep.rules_jaxpr import (  # noqa: E402
+    ConstantBloat,
+    DonationAudit,
+    DtypePromotionAudit,
+    HostCallbackInProgram,
+    WhileCarryConsistency,
+)
+from tools.pertlint.deep.rules_sharding import (  # noqa: E402
+    INDIVISIBLE,
+    RANK,
+    REUSE,
+    UNKNOWN,
+    ShardingContract,
+    ShardingDivisibility,
+    check_spec_against_shape,
+)
+
+BASELINE = REPO_ROOT / "tools" / "pertlint" / "baseline.json"
+
+S = jax.ShapeDtypeStruct
+f32 = jnp.float32
+
+
+def _ctx_for(fn, dynamic, declared_donate=(), name="synthetic",
+             kwargs=None):
+    """ProgramContext of a synthetic jitted fn (args all dynamic)."""
+    prog = entrypoints.EntryProgram(
+        name=name, anchor=fn, jit_fn=fn,
+        args=tuple(v for _, v in dynamic), kwargs=kwargs or {},
+        dynamic_args=list(dynamic), declared_donate=tuple(declared_donate))
+    with warnings.catch_warnings():
+        # a deliberately-unusable donation warns; that IS the test
+        warnings.simplefilter("ignore")
+        return trace.build_program_context(prog)
+
+
+# ---------------------------------------------------------------------------
+# traced-unit: each jaxpr rule catches its seeded defect
+# ---------------------------------------------------------------------------
+
+def test_dp003_catches_broken_donation():
+    """The PR-4 bug shape: donate_argnames declared, but no output
+    matches the donated buffer, so the lowered module carries NO
+    input_output_alias for it — the donation silently does nothing."""
+    @functools.partial(jax.jit, donate_argnames=("params0",))
+    def broken(params0, x):
+        # params0 is consumed but no output matches its (8, 8) f32 aval,
+        # so the lowering cannot realise the declared donation
+        return (jnp.sum(params0) + x,)
+
+    ctx = _ctx_for(broken, [("params0", S((8, 8), f32)),
+                            ("x", S((4,), f32))],
+                   declared_donate=("params0",))
+    findings = list(DonationAudit().check(ctx))
+    assert any("NO input_output_alias" in f.message for f in findings), \
+        [f.message for f in findings]
+
+
+def test_dp003_count_fallback_on_unused_donated_arg():
+    """A donated arg so dead it is pruned from the lowered signature:
+    leaf attribution degrades, but the audit still fails via the
+    declared-vs-realised count comparison."""
+    @functools.partial(jax.jit, donate_argnames=("params0",))
+    def broken(params0, x):
+        return (x * 2.0,)
+
+    ctx = _ctx_for(broken, [("params0", S((8, 8), f32)),
+                            ("x", S((4,), f32))],
+                   declared_donate=("params0",))
+    findings = list(DonationAudit().check(ctx))
+    assert any("input_output_alias" in f.message for f in findings)
+
+
+def test_dp003_clean_on_healthy_donation():
+    @functools.partial(jax.jit, donate_argnames=("params0",))
+    def healthy(params0, x):
+        return params0 + x, x
+
+    ctx = _ctx_for(healthy, [("params0", S((8,), f32)),
+                             ("x", S((8,), f32))],
+                   declared_donate=("params0",))
+    assert list(DonationAudit().check(ctx)) == []
+
+
+def test_dp003_flags_undonated_init_buffer():
+    def plain(params0, x):
+        return params0 + x
+
+    fn = jax.jit(plain)
+    ctx = _ctx_for(fn, [("params0", S((8,), f32)), ("x", S((8,), f32))])
+    findings = list(DonationAudit().check(ctx))
+    assert any("not donated" in f.message for f in findings)
+
+
+def test_dp003_flags_donation_typo():
+    def plain(params0, x):
+        return params0 + x
+
+    ctx = _ctx_for(jax.jit(plain),
+                   [("params0", S((8,), f32)), ("x", S((8,), f32))],
+                   declared_donate=("params0", "opt_stat0"))  # typo'd name
+    findings = list(DonationAudit().check(ctx))
+    assert any("no such dynamic argument" in f.message for f in findings)
+
+
+def test_dp001_catches_f64_leak():
+    def leaky(x):
+        return x * 2.0
+
+    with jax.experimental.enable_x64():
+        ctx = _ctx_for(jax.jit(leaky), [("x", S((4,), jnp.float64))])
+    findings = list(DtypePromotionAudit().check(ctx))
+    assert any("float64" in f.message for f in findings)
+
+
+def test_dp001_catches_f32_to_bf16_narrowing():
+    def narrowing(x):
+        return (x.astype(jnp.bfloat16) * 2).astype(f32)
+
+    ctx = _ctx_for(jax.jit(narrowing), [("x", S((4,), f32))])
+    findings = list(DtypePromotionAudit().check(ctx))
+    assert any("f32->bf16" in f.message for f in findings)
+
+
+def test_dp002_catches_debug_print():
+    def chatty(x):
+        jax.debug.print("x = {x}", x=x)
+        return x * 2
+
+    ctx = _ctx_for(jax.jit(chatty), [("x", S((4,), f32))])
+    findings = list(HostCallbackInProgram().check(ctx))
+    assert any("debug_callback" in f.message for f in findings)
+
+
+def test_dp004_catches_constant_bloat():
+    big = np.ones((600, 600), np.float32)  # 1.44 MB > the 1 MiB threshold
+
+    def bloated(x):
+        return x + jnp.asarray(big)[0, :4]
+
+    ctx = _ctx_for(jax.jit(bloated), [("x", S((4,), f32))])
+    findings = list(ConstantBloat().check(ctx))
+    assert any("closed-over constant" in f.message for f in findings)
+    assert "(600, 600)" in findings[0].message
+
+
+def test_dp005_catches_weak_typed_carry():
+    def loopy(x):
+        # the 0 literal leaks a weak int32 into the carry
+        return jax.lax.while_loop(lambda c: c[0] < 3,
+                                  lambda c: (c[0] + 1, c[1] * 2.0),
+                                  (0, x))
+
+    ctx = _ctx_for(jax.jit(loopy), [("x", S((), f32))])
+    findings = list(WhileCarryConsistency().check(ctx))
+    assert any("weakly typed" in f.message for f in findings)
+
+
+def test_dp005_mismatched_carry_unit():
+    """Init-vs-body aval disagreement (not constructible through jax's
+    own trace-time checks) via a hand-built context."""
+    entry = trace.WhileCarryEntry(
+        position=3,
+        init=trace.AvalInfo(shape=(8,), dtype="float32"),
+        body_out=trace.AvalInfo(shape=(8,), dtype="bfloat16"))
+    ctx = trace.ProgramContext(
+        name="unit", path="x.py", line=1, primitives=[], out_avals=[],
+        var_avals=[], converts=[], consts=[], leaves=[],
+        declared_donate=(), dynamic_arg_names=(), while_carries=[entry],
+        alias_count=0, donated_leaf_count=0)
+    findings = list(WhileCarryConsistency().check(ctx))
+    assert len(findings) == 1 and "slot 3" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# pure-unit: the sharding contract checker, one test per failure mode
+# ---------------------------------------------------------------------------
+
+EXTENTS = {"cells": 4, "loci": 2}
+
+
+def _codes(spec, rank, shape):
+    return [c for c, _ in check_spec_against_shape(spec, rank, shape,
+                                                   EXTENTS)]
+
+
+def test_contract_clean_spec_passes():
+    assert _codes((("cells",), ("loci",)), 2, (8, 16)) == []
+
+
+def test_contract_unknown_axis():
+    assert _codes((("cells",), ("model",)), 2, (8, 16)) == [UNKNOWN]
+
+
+def test_contract_rank_overflow():
+    # trailing None dims count: the factory believes the tensor is 3-D
+    assert _codes((("cells",), (), ()), 3, (8, 16)) == [RANK]
+
+
+def test_contract_axis_reuse():
+    assert _codes((("cells",), ("cells",)), 2, (8, 16)) == [REUSE]
+
+
+def test_contract_indivisible_shape():
+    # 9 cells over 4 shards does not divide
+    assert _codes((("cells",), ("loci",)), 2, (9, 16)) == [INDIVISIBLE]
+
+
+def test_contract_multi_axis_dim_extent():
+    # ('cells','loci') on one dim shards it 8-ways: 16 % 8 == 0 passes,
+    # 12 % 8 fails
+    spec = (("cells", "loci"), ())
+    assert _codes(spec, 2, (16, 3)) == []
+    assert _codes(spec, 2, (12, 3)) == [INDIVISIBLE]
+
+
+def test_contract_on_head_is_clean():
+    """The real layout.py contract against the canonical 4x2 mesh and
+    shapes: zero findings — the machine-checked form of the 'single
+    owner of the tensor-layout contract' docstring."""
+    ctx = trace.build_contract_context(entrypoints.CANONICAL_DIMS,
+                                       entrypoints.MESH_EXTENTS)
+    assert len(ctx.rows) >= 20  # batch + params + 3 shard_map factories
+    findings = list(ShardingContract().check(ctx)) \
+        + list(ShardingDivisibility().check(ctx))
+    assert findings == [], [f.message for f in findings]
+
+
+def test_contract_catches_seeded_bad_rows():
+    ctx = trace.build_contract_context(entrypoints.CANONICAL_DIMS,
+                                       entrypoints.MESH_EXTENTS)
+    ctx.rows.append(trace.ContractRow(
+        tensor="seeded.bad", factory="batch_specs",
+        spec=(("cells",), ("rows",)), spec_rank=3, shape=(8, 16), line=1))
+    c6 = list(ShardingContract().check(ctx))
+    assert {m for f in c6 for m in [f.message] if "seeded.bad" in m}
+    assert any("unknown" in f.message or "rows" in f.message for f in c6)
+    assert any("rank" in f.message for f in c6)
+
+
+# ---------------------------------------------------------------------------
+# the gate
+# ---------------------------------------------------------------------------
+
+def test_registry_traces_all_entry_points():
+    """Acceptance: >= 6 registered entry points trace on CPU, covering
+    the fit chunk, loss, decode slab, PPC and the sharded placements."""
+    findings, stats = run_deep_rules()
+    assert len(stats.entrypoints) >= 6, stats
+    assert {"fit_chunk", "loss", "decode_slab", "ppc"} \
+        <= set(stats.entrypoints)
+    assert {"sharded_batch", "sharded_params"} <= set(stats.entrypoints) \
+        or stats.skipped  # skipped only when the backend lacks devices
+    assert stats.contract_rows >= 20
+
+
+def test_deep_gate_is_clean_on_head():
+    """THE gate: zero unbaselined deep findings against the shipped
+    baseline, in-process (fast path for iteration)."""
+    result, stats, _ = deep_lint(baseline_path=BASELINE)
+    assert result.new == [], [f.render() for f in result.new]
+    assert stats.unrationalized == []
+
+
+def test_deep_cli_gate_subprocess():
+    """Exactly as CI runs it: ``python -m tools.pertlint --deep``."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.pertlint", "--deep",
+         "--baseline", str(BASELINE)],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "entry points traced" in proc.stdout
+
+
+def test_baselined_deep_findings_carry_rationale():
+    """Acceptance: every baselined deep (DP) finding has a one-line
+    rationale — semantic debt without a recorded WHY does not ship."""
+    entries = json.loads(BASELINE.read_text())["findings"]
+    dp = [e for e in entries if e["rule"].startswith("DP")]
+    for e in dp:
+        assert e.get("rationale"), f"DP entry without rationale: {e}"
+
+
+def test_svi_donation_sites_all_alias():
+    """Acceptance: every donate_argnames site in infer/svi.py produces
+    real input_output_aliases — the fit program end to end, and the
+    chunk program for each of its declared donations."""
+    for build in (entrypoints.build_fit, entrypoints.build_fit_chunk):
+        prog = build()
+        ctx = trace.build_program_context(prog)
+        donated = [l for l in ctx.leaves if l.donated]
+        assert donated, prog.name
+        assert all(l.aliased for l in donated), \
+            (prog.name, [(l.arg, l.keypath) for l in donated
+                         if not l.aliased])

@@ -4,16 +4,20 @@ The Pyro reference only needed a ``cuda`` flag; the TPU path depends on
 invariants XLA never checks for us — no host syncs inside compiled
 loops, no Python control flow on tracers, shardings owned by
 ``layout.py``, f32-stable dtypes in the enumeration kernel.  pertlint
-encodes each invariant as an AST rule (PL001..PL006) and gates CI:
+encodes each invariant in one of two layers and gates CI:
 
-    python -m tools.pertlint scdna_replication_tools_tpu
+    python -m tools.pertlint scdna_replication_tools_tpu   # AST (PLnnn)
+    python -m tools.pertlint --deep                        # deep (DPnnn)
 
-exits non-zero on any violation that is neither inline-suppressed
-(``# pertlint: disable=RULE``) nor grandfathered in the checked-in
-baseline (``tools/pertlint/baseline.json``).
-
-Pure stdlib (``ast`` + ``tokenize``): importable and runnable with no
-jax/numpy installed, so the CI lint job stays seconds-fast.
+The AST layer lints source text and is pure stdlib (``ast`` +
+``tokenize``) — importable and runnable with no jax/numpy installed, so
+the fast path of the CI lint job stays seconds-fast.  The deep layer
+(``tools/pertlint/deep``) traces the package's real jit entry points on
+abstract inputs and audits the jaxprs, the lowered modules and the
+tensor-layout contract.  Both exit non-zero on any violation that is
+neither inline-suppressed (``# pertlint: disable=RULE``) nor
+grandfathered in the checked-in baseline
+(``tools/pertlint/baseline.json``).
 """
 
 from tools.pertlint.core import Finding, Rule, all_rules  # noqa: F401

@@ -9,8 +9,14 @@ line numbers, and only breaks when the flagged line itself changes
 Workflow: ``python -m tools.pertlint <paths> --write-baseline`` snapshots
 every current finding; subsequent runs report (and gate on) only
 findings that are NOT in the snapshot.  Stale entries — fingerprints no
-longer produced by the tree — are reported so the baseline shrinks as
-debt is paid down; ``--write-baseline`` prunes them.
+longer produced by the tree, or pointing at files that no longer exist —
+are WARNED about so the baseline shrinks as debt is paid down;
+``--update-baseline`` prunes them without grandfathering anything new.
+
+Entries may carry a ``rationale`` field — one line on WHY the finding is
+acceptable debt rather than a bug.  Deep (DP-rule) entries are required
+to have one (the deep gate warns otherwise); re-snapshotting preserves
+rationales by fingerprint so ``--write-baseline`` never erases them.
 """
 
 from __future__ import annotations
@@ -41,7 +47,8 @@ def fingerprint_findings(findings: Iterable[Finding],
     """
     seen: Dict[Tuple[str, str, str], int] = {}
     out = []
-    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule)):
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule,
+                                             f.message)):
         lines = sources.get(f.path, [])
         text = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
         key = (f.rule, f.path, text.strip())
@@ -67,19 +74,58 @@ def load(path: pathlib.Path) -> Set[str]:
     return {e["fingerprint"] for e in load_entries(path)}
 
 
+def entry_file_exists(path_str: str,
+                      baseline_path: pathlib.Path = None) -> bool:
+    """Does an entry's flagged file exist?  Relative entry paths are
+    checked against the CWD and — because relative baseline paths are
+    repo-root-relative while the process may run from elsewhere — every
+    ancestor of the baseline file.  Errs toward "exists": the callers
+    prune/warn on the negative, and a wrong-CWD invocation must not
+    wipe grandfathered debt.
+    """
+    p = pathlib.Path(path_str or "")
+    if p.is_absolute() or baseline_path is None:
+        return p.is_file()
+    if p.is_file():
+        return True
+    return any((root / p).is_file()
+               for root in pathlib.Path(baseline_path).resolve().parents)
+
+
+def missing_file_entries(entries: List[dict],
+                         baseline_path: pathlib.Path = None) -> List[dict]:
+    """Entries whose flagged file no longer exists on disk — dead weight
+    a lint run can never match (the lint walks real files only)."""
+    return [e for e in entries
+            if not entry_file_exists(e.get("path", ""), baseline_path)]
+
+
+def rationales(entries: List[dict]) -> Dict[str, str]:
+    """fingerprint -> rationale for every entry that carries one."""
+    return {e["fingerprint"]: e["rationale"]
+            for e in entries if e.get("rationale")}
+
+
 def write(path: pathlib.Path,
           fingerprinted: List[Tuple[Finding, str]],
-          retained_entries: List[dict] = ()) -> None:
+          retained_entries: List[dict] = (),
+          keep_rationales: Dict[str, str] = None) -> None:
     """Write retained (out-of-scope) entries + the fresh snapshot.
 
-    ``retained_entries`` are prior entries for paths NOT covered by the
-    snapshot run — a partial-tree ``--write-baseline`` must not silently
-    drop the rest of the grandfathered debt.
+    ``retained_entries`` are prior entries for paths/rules NOT covered by
+    the snapshot run — a partial ``--write-baseline`` must not silently
+    drop the rest of the grandfathered debt.  ``keep_rationales``
+    (fingerprint -> text) re-attaches rationales to re-snapshotted
+    entries so regenerating the file never erases the documented WHY.
     """
-    entries = list(retained_entries) + [
-        {"rule": f.rule, "path": f.path, "line": f.line,
-         "fingerprint": fp, "message": f.message}
-        for f, fp in fingerprinted]
+    keep_rationales = keep_rationales or {}
+    entries = list(retained_entries)
+    for f, fp in fingerprinted:
+        entry = {"rule": f.rule, "path": f.path, "line": f.line,
+                 "fingerprint": fp, "message": f.message}
+        if fp in keep_rationales:
+            entry["rationale"] = keep_rationales[fp]
+        entries.append(entry)
     entries.sort(key=lambda e: (e["path"], e["line"], e["rule"]))
     path.write_text(json.dumps(
         {"version": BASELINE_VERSION,

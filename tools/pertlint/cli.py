@@ -1,9 +1,19 @@
 """``python -m tools.pertlint`` — the CI gate.
 
+Two analysis layers share one CLI, one baseline and one suppression
+syntax: the stdlib AST layer (PLnnn rules, runs over the given paths)
+and the deep jaxpr/sharding layer (DPnnn rules, ``--deep``; traces the
+registered jit entry points on abstract inputs — needs jax, no
+devices).  ``--deep`` alone runs just the deep gate; paths plus
+``--deep`` runs both and gates on the union.
+
 Exit codes: 0 clean (no new error-severity findings), 1 new violations,
 2 usage/parse errors.  ``--write-baseline`` snapshots the current
-findings as grandfathered; ``--no-baseline`` ignores the baseline file
-(shows the whole debt).
+findings as grandfathered; ``--update-baseline`` only PRUNES stale/dead
+entries (never grandfathers anything new); ``--no-baseline`` ignores
+the baseline file (shows the whole debt).  ``--format=github`` renders
+findings as GitHub Actions workflow annotations so CI failures mark up
+the diff.
 """
 
 from __future__ import annotations
@@ -14,18 +24,97 @@ import pathlib
 import sys
 from typing import List, Optional
 
-from tools.pertlint.core import all_rules
-from tools.pertlint.engine import lint_paths, snapshot_baseline
+from tools.pertlint.core import Finding, all_rules
+from tools.pertlint.engine import (
+    LintResult,
+    lint_paths,
+    snapshot_baseline,
+    update_baseline,
+)
 
 DEFAULT_BASELINE = pathlib.Path(__file__).parent / "baseline.json"
 
 
 def _list_rules() -> str:
-    lines = ["pertlint rules:"]
-    for rule in all_rules():
+    lines = ["pertlint rules (ast layer):"]
+    for rule in all_rules(kind="ast"):
+        lines.append(f"  {rule.id}  {rule.name:<20} [{rule.severity}] "
+                     f"{rule.description}")
+    lines.append("pertlint rules (deep layer, --deep):")
+    for rule in all_rules(kind="deep"):
         lines.append(f"  {rule.id}  {rule.name:<20} [{rule.severity}] "
                      f"{rule.description}")
     return "\n".join(lines)
+
+
+def _github_annotation(f: Finding) -> str:
+    level = "error" if f.severity == "error" else "warning"
+    # '::' and newlines would terminate the annotation early
+    message = f.message.replace("%", "%25").replace("\n", "%0A")
+    return (f"::{level} file={f.path},line={f.line},col={f.col + 1},"
+            f"title=pertlint {f.rule}::{message}")
+
+
+def _warn(args, text: str) -> None:
+    if args.format == "github":
+        print(f"::warning title=pertlint::{text}")
+    else:
+        print(f"pertlint: warning: {text}", file=sys.stderr)
+
+
+def _render(args, result: LintResult, deep_stats=None) -> None:
+    if args.format == "json":
+        payload = {
+            "files_checked": result.files_checked,
+            "new": [vars(f) for f in result.new],
+            "baselined": len(result.baselined),
+            "suppressed": len(result.suppressed),
+            "stale_baseline": sorted(result.stale_baseline),
+            "missing_files": result.missing_files,
+            "parse_errors": result.parse_errors,
+        }
+        if deep_stats is not None:
+            payload["deep"] = {
+                "entrypoints": deep_stats.entrypoints,
+                "skipped": deep_stats.skipped,
+                "contract_rows": deep_stats.contract_rows,
+                "unrationalized": deep_stats.unrationalized,
+            }
+        print(json.dumps(payload, indent=1))
+        return
+
+    for f in result.new:
+        print(_github_annotation(f) if args.format == "github"
+              else f.render())
+    for path, msg in result.parse_errors:
+        print(f"{path}:1:0: parse-error {msg}", file=sys.stderr)
+    if result.stale_baseline:
+        n = len(result.stale_baseline)
+        _warn(args, f"{n} stale baseline entr{'ies' if n != 1 else 'y'} "
+                    f"(fixed or edited) — run --update-baseline to prune")
+    if result.missing_files:
+        _warn(args, f"baseline references {len(result.missing_files)} "
+                    f"missing file(s): {', '.join(result.missing_files)} — "
+                    f"run --update-baseline to prune")
+    if deep_stats is not None and deep_stats.unrationalized:
+        _warn(args, f"{len(deep_stats.unrationalized)} baselined deep "
+                    f"finding(s) lack a 'rationale' — semantic debt needs "
+                    f"a recorded WHY (edit the baseline entries: "
+                    f"{', '.join(deep_stats.unrationalized)})")
+    gating = result.gating
+    warnings = len(result.new) - len(gating)
+    deep_note = ""
+    if deep_stats is not None:
+        deep_note = (f"; deep: {len(deep_stats.entrypoints)} entry points "
+                     f"traced, {deep_stats.contract_rows} contract rows")
+        if deep_stats.skipped:
+            deep_note += f", {len(deep_stats.skipped)} skipped"
+    print(f"pertlint: {result.files_checked} files, "
+          f"{len(gating)} new violation{'s' if len(gating) != 1 else ''}"
+          + (f" + {warnings} warning{'s' if warnings != 1 else ''}"
+             if warnings else "")
+          + f" ({len(result.baselined)} baselined, "
+            f"{len(result.suppressed)} suppressed)" + deep_note)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -34,8 +123,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="JAX/TPU-aware static analysis for the PERT port "
                     "(see tools/pertlint/README.md)")
     ap.add_argument("paths", nargs="*",
-                    help="files or directories to lint "
-                         "(e.g. scdna_replication_tools_tpu)")
+                    help="files or directories to lint with the AST layer "
+                         "(e.g. scdna_replication_tools_tpu); may be empty "
+                         "with --deep")
+    ap.add_argument("--deep", action="store_true",
+                    help="also run the deep jaxpr/sharding layer "
+                         "(DP rules; traces the registered jit entry "
+                         "points on abstract inputs — needs jax, CPU only)")
     ap.add_argument("--baseline", type=pathlib.Path,
                     default=DEFAULT_BASELINE,
                     help="baseline file of grandfathered findings "
@@ -44,30 +138,61 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="ignore the baseline; report the full debt")
     ap.add_argument("--write-baseline", action="store_true",
                     help="snapshot current findings into --baseline and "
-                         "exit 0")
+                         "exit 0 (rationales survive by fingerprint)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="prune stale/dead baseline entries and exit 0 — "
+                         "never grandfathers new findings")
     ap.add_argument("--select", default=None,
                     help="comma-separated rule ids to run (default: all)")
-    ap.add_argument("--format", choices=["text", "json"], default="text")
+    ap.add_argument("--format", choices=["text", "json", "github"],
+                    default="text",
+                    help="github = GitHub Actions ::error/::warning "
+                         "annotations (CI marks up the diff)")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         print(_list_rules())
         return 0
-    if not args.paths:
+    if not args.paths and not args.deep:
         ap.print_usage(sys.stderr)
-        print("error: no paths given", file=sys.stderr)
+        print("error: no paths given (and --deep not requested)",
+              file=sys.stderr)
+        return 2
+    if args.write_baseline and args.update_baseline:
+        print("error: --write-baseline and --update-baseline are "
+              "mutually exclusive", file=sys.stderr)
         return 2
 
-    rules = all_rules()
+    ast_rules = all_rules(kind="ast")
+    deep_ids = {r.id for r in all_rules(kind="deep")}
+    deep_select = None
     if args.select:
         wanted = {r.strip() for r in args.select.split(",") if r.strip()}
-        unknown = wanted - {r.id for r in rules}
+        known = {r.id for r in all_rules(kind=None)}
+        unknown = wanted - known
         if unknown:
             print(f"error: unknown rule ids {sorted(unknown)}",
                   file=sys.stderr)
             return 2
-        rules = [r for r in rules if r.id in wanted]
+        if (wanted & deep_ids) and not args.deep:
+            # without --deep the deep layer never runs: exiting 0 here
+            # would be a silent false-clean on the selected DP rules
+            print(f"error: selected deep rule(s) "
+                  f"{sorted(wanted & deep_ids)} require --deep",
+                  file=sys.stderr)
+            return 2
+        ast_rules = [r for r in ast_rules if r.id in wanted]
+        deep_select = wanted
+
+    deep_result = deep_stats = None
+    deep_fingerprinted = []
+    if args.deep:
+        from tools.pertlint.deep.engine import deep_lint
+
+        baseline = None if args.no_baseline else args.baseline
+        deep_result, deep_stats, deep_fingerprinted = deep_lint(
+            select=deep_select, baseline_path=baseline)
 
     if args.write_baseline:
         if args.select:
@@ -78,42 +203,44 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "--select (it would drop the unselected rules' "
                   "grandfathered entries)", file=sys.stderr)
             return 2
-        n = snapshot_baseline(args.paths, args.baseline, rules=rules)
+        n = snapshot_baseline(args.paths, args.baseline, rules=ast_rules,
+                              extra_fingerprinted=deep_fingerprinted,
+                              extra_rule_ids=deep_ids if args.deep
+                              else set())
         print(f"pertlint: baseline written to {args.baseline} "
               f"({n} grandfathered finding{'s' if n != 1 else ''}; "
-              f"entries outside the given paths retained)")
+              f"entries outside the given paths/rules retained)")
+        if deep_fingerprinted:
+            print("pertlint: note: add a one-line 'rationale' to every "
+                  "new DP entry — deep debt without a WHY does not pass "
+                  "review")
+        return 0
+
+    if args.update_baseline:
+        extra_produced = {fp for _, fp in deep_fingerprinted}
+        # only the deep rules that actually RAN may prune their entries
+        extra_rule_ids = set()
+        if args.deep:
+            extra_rule_ids = (deep_ids & deep_select if deep_select
+                              else deep_ids)
+        kept, pruned = update_baseline(
+            args.paths, args.baseline, rules=ast_rules,
+            extra_produced=extra_produced, extra_rule_ids=extra_rule_ids)
+        print(f"pertlint: baseline updated — {kept} entries kept, "
+              f"{pruned} stale/dead entr{'ies' if pruned != 1 else 'y'} "
+              f"pruned")
         return 0
 
     baseline = None if args.no_baseline else args.baseline
-    result = lint_paths(args.paths, baseline_path=baseline, rules=rules)
+    result = LintResult(new=[], baselined=[], suppressed=[],
+                        stale_baseline=set(), parse_errors=[])
+    if args.paths:
+        result = lint_paths(args.paths, baseline_path=baseline,
+                            rules=ast_rules)
+    if deep_result is not None:
+        result = result.merge(deep_result)
 
-    if args.format == "json":
-        print(json.dumps({
-            "files_checked": result.files_checked,
-            "new": [vars(f) for f in result.new],
-            "baselined": len(result.baselined),
-            "suppressed": len(result.suppressed),
-            "stale_baseline": sorted(result.stale_baseline),
-            "parse_errors": result.parse_errors,
-        }, indent=1))
-    else:
-        for f in result.new:
-            print(f.render())
-        for path, msg in result.parse_errors:
-            print(f"{path}:1:0: parse-error {msg}", file=sys.stderr)
-        if result.stale_baseline:
-            print(f"pertlint: note: {len(result.stale_baseline)} stale "
-                  f"baseline entr{'ies' if len(result.stale_baseline) != 1 else 'y'} "
-                  f"(fixed or edited) — run --write-baseline to prune",
-                  file=sys.stderr)
-        gating = result.gating
-        warnings = len(result.new) - len(gating)
-        print(f"pertlint: {result.files_checked} files, "
-              f"{len(gating)} new violation{'s' if len(gating) != 1 else ''}"
-              + (f" + {warnings} warning{'s' if warnings != 1 else ''}"
-                 if warnings else "")
-              + f" ({len(result.baselined)} baselined, "
-                f"{len(result.suppressed)} suppressed)")
+    _render(args, result, deep_stats)
 
     if result.parse_errors:
         return 2

@@ -1,24 +1,36 @@
 """Rule registry and the Finding record every rule emits.
 
-A rule is a class with a stable ``id`` (``PLnnn``), a ``severity``
-(``error`` gates the build; ``warning`` is reported but never flips the
-exit code on its own — the knob exists so a new rule can soak before it
-gates), and a ``check(ctx)`` generator yielding :class:`Finding`.
-Registration is a decorator so each rule module is self-contained and
+A rule is a class with a stable ``id`` (``PLnnn`` for the AST layer,
+``DPnnn`` for the deep jaxpr/sharding layer), a ``severity`` (``error``
+gates the build; ``warning`` is reported but never flips the exit code
+on its own — the knob exists so a new rule can soak before it gates),
+and a ``check(ctx)`` generator yielding :class:`Finding`.  Registration
+is a decorator so each rule module is self-contained and
 ``rules/__init__.py`` only has to import them.
+
+Two rule KINDS share the registry:
+
+* ``ast`` (PLnnn) — pure-stdlib source-text rules; ``check`` receives an
+  ``engine.FileContext``;
+* ``deep`` (DPnnn) — semantic rules over traced programs; ``check``
+  receives a context built by ``tools.pertlint.deep.engine`` (a
+  ``ProgramContext`` per jit entry point, or the layout contract).  The
+  deep rule CLASSES are stdlib-importable (jax is imported only when a
+  deep check actually runs) so ``--list-rules`` works without jax.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Iterable, List, Type
+from typing import Dict, Iterable, List, Optional, Type
 
 SEVERITIES = ("error", "warning")
+KINDS = ("ast", "deep")
 
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
-    rule: str       # "PL001"
+    rule: str       # "PL001" / "DP003"
     severity: str   # "error" | "warning"
     path: str       # posix path as given to the engine (repo-relative in CI)
     line: int       # 1-based, the AST node's lineno
@@ -37,6 +49,7 @@ class Rule:
     name: str = ""
     severity: str = "error"
     description: str = ""
+    kind: str = "ast"
 
     def check(self, ctx) -> Iterable[Finding]:  # ctx: engine.FileContext
         raise NotImplementedError
@@ -49,10 +62,16 @@ class Rule:
 
 _REGISTRY: Dict[str, Type[Rule]] = {}
 
+_PREFIX_BY_KIND = {"ast": "PL", "deep": "DP"}
+
 
 def register(cls: Type[Rule]) -> Type[Rule]:
-    if not cls.id or not cls.id.startswith("PL"):
-        raise ValueError(f"rule {cls.__name__} needs a PLnnn id")
+    if cls.kind not in KINDS:
+        raise ValueError(f"rule {cls.__name__}: bad kind {cls.kind!r}")
+    prefix = _PREFIX_BY_KIND[cls.kind]
+    if not cls.id or not cls.id.startswith(prefix):
+        raise ValueError(f"rule {cls.__name__} ({cls.kind}) needs a "
+                         f"{prefix}nnn id")
     if cls.severity not in SEVERITIES:
         raise ValueError(f"rule {cls.id}: bad severity {cls.severity!r}")
     if cls.id in _REGISTRY:
@@ -61,7 +80,16 @@ def register(cls: Type[Rule]) -> Type[Rule]:
     return cls
 
 
-def all_rules() -> List[Rule]:
-    """Fresh instances of every registered rule, id-ordered."""
+def all_rules(kind: Optional[str] = "ast") -> List[Rule]:
+    """Fresh instances of every registered rule of ``kind``, id-ordered.
+
+    Default is the AST layer — the engine's and tests' historical
+    contract.  ``kind='deep'`` returns the jaxpr/sharding rules;
+    ``kind=None`` returns both (the CLI's ``--list-rules``).  Importing
+    either rule package is stdlib-only.
+    """
     import tools.pertlint.rules  # noqa: F401 — importing registers them
-    return [_REGISTRY[rid]() for rid in sorted(_REGISTRY)]
+    import tools.pertlint.deep.rules_jaxpr  # noqa: F401
+    import tools.pertlint.deep.rules_sharding  # noqa: F401
+    return [_REGISTRY[rid]() for rid in sorted(_REGISTRY)
+            if kind is None or _REGISTRY[rid].kind == kind]

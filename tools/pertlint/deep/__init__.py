@@ -1,0 +1,30 @@
+"""pertlint-deep: jaxpr- and sharding-level analysis of the traced pipeline.
+
+The AST layer (``tools/pertlint/rules``) lints *source text*; this
+package lints the *programs* XLA actually sees.  Because the package's
+inference is trace-once/compile-once (one ``lax.while_loop`` per fit,
+one compiled slab per decode), every dtype promotion, lost donation,
+baked-in constant and sharding decision is statically visible in the
+jaxpr and the lowered StableHLO **before anything runs** — so we check
+them there, on abstract inputs (``jax.eval_shape`` / ``.trace()`` /
+``.lower()`` on CPU; nothing is executed, no devices are required
+beyond the forced-host CPU backend).
+
+Layout:
+
+* ``entrypoints.py`` — the registry of real jit entry points with
+  canonical abstract shapes (fit, fit chunk, loss, decode slab, PPC,
+  sharded batch/param placement);
+* ``trace.py`` — turns one entry point into a ``ProgramContext``:
+  closed jaxpr, flattened argument leaves with declared-donation and
+  lowered input/output-alias facts, while-carry descriptors, constants;
+* ``rules_jaxpr.py`` — DP001..DP005 over ``ProgramContext``;
+* ``rules_sharding.py`` — DP006/DP007 over the machine-readable layout
+  contract (``scdna_replication_tools_tpu.layout.contract_entries``);
+* ``engine.py`` — drives it all and feeds findings through the SAME
+  suppression + content-addressed-baseline machinery as the AST layer,
+  so ``python -m tools.pertlint --deep`` is one gate.
+
+Rule classes are stdlib-importable (``--list-rules`` works without
+jax); jax is imported only when a deep run actually traces.
+"""

@@ -1,0 +1,176 @@
+"""Drive the deep pass and feed it through pertlint's shared machinery.
+
+``deep_lint`` builds every registered entry point on abstract inputs,
+traces/lowers each (CPU, nothing executes), resolves the layout
+contract, runs the DP rules, then applies the SAME inline-suppression
+and content-addressed-baseline filtering as the AST layer — so
+``python -m tools.pertlint --deep`` is one gate with one workflow.
+
+Deep findings anchor at real source lines (the jit decoration, the
+layout factory def), which is what makes the shared machinery work:
+an inline ``# pertlint: disable=DP003`` on that line suppresses, and the
+baseline fingerprint is content-addressed to that line's text.  Deep
+baseline entries are expected to carry a one-line ``rationale`` —
+grandfathered *semantic* debt with no recorded WHY rots instantly — and
+the run reports entries that lack one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pathlib
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.pertlint import baseline as baseline_mod
+from tools.pertlint import suppress
+from tools.pertlint.core import Finding, Rule, all_rules
+from tools.pertlint.engine import LintResult
+
+
+@dataclasses.dataclass
+class DeepStats:
+    """Run facts the CLI reports next to the LintResult."""
+    entrypoints: List[str]            # successfully traced entries
+    skipped: List[str]                # builder skip reasons (devices)
+    contract_rows: int = 0
+    unrationalized: List[str] = dataclasses.field(default_factory=list)
+    # fingerprints of matched DP baseline entries missing a rationale
+
+
+def _ensure_cpu_devices(min_devices: int) -> None:
+    """Force the multi-device CPU backend the placement entries need.
+
+    Effective only when the jax backend is not yet initialised (the
+    normal case for a fresh ``python -m tools.pertlint --deep``
+    process); an already-initialised single-device backend just means
+    the placement entries skip.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{min_devices}").strip()
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — config key availability varies
+        pass
+
+
+def _deep_rules(select: Optional[Set[str]] = None) -> List[Rule]:
+    rules = all_rules(kind="deep")
+    if select is not None:
+        rules = [r for r in rules if r.id in select]
+    return rules
+
+
+def run_deep_rules(select: Optional[Set[str]] = None,
+                   entry_names: Optional[Sequence[str]] = None
+                   ) -> Tuple[List[Finding], DeepStats]:
+    """Trace the registry and run the DP rules -> raw (unfiltered)
+    findings + stats.  Build/trace failures propagate: a gate that
+    cannot see its programs must fail loudly, not shrink."""
+    from tools.pertlint.deep import entrypoints, trace
+
+    rules = _deep_rules(select)
+    program_rules = [r for r in rules if r.context == "program"]
+    contract_rules = [r for r in rules if r.context == "contract"]
+    if not rules:
+        # --deep --select <only-PL-ids>: nothing to run — do not pay
+        # the tracing cost for zero rules
+        return [], DeepStats(entrypoints=[], skipped=[])
+
+    _ensure_cpu_devices(entrypoints.MESH_EXTENTS["cells"]
+                        * entrypoints.MESH_EXTENTS["loci"])
+
+    findings: List[Finding] = []
+    traced: List[str] = []
+    skipped: List[str] = []
+    if program_rules:
+        progs, skipped = entrypoints.build_all(
+            list(entry_names) if entry_names is not None else None)
+        for prog in progs:
+            ctx = trace.build_program_context(prog)
+            traced.append(prog.name)
+            for rule in program_rules:
+                findings.extend(rule.check(ctx))
+
+    contract_rows = 0
+    if contract_rules:
+        ctx = trace.build_contract_context(entrypoints.CANONICAL_DIMS,
+                                           entrypoints.MESH_EXTENTS)
+        contract_rows = len(ctx.rows)
+        for rule in contract_rules:
+            findings.extend(rule.check(ctx))
+
+    return findings, DeepStats(entrypoints=traced, skipped=skipped,
+                               contract_rows=contract_rows)
+
+
+def _filter_suppressed(findings: List[Finding],
+                       sources: Dict[str, List[str]]
+                       ) -> Tuple[List[Finding], List[Finding]]:
+    kept: List[Finding] = []
+    dropped: List[Finding] = []
+    parsed: Dict[str, tuple] = {}
+    for f in findings:
+        if f.path not in parsed:
+            text = "\n".join(sources.get(f.path, []))
+            parsed[f.path] = suppress.parse_suppressions(text)
+        per_line, file_wide = parsed[f.path]
+        if suppress.is_suppressed(f.rule, f.line, per_line, file_wide):
+            dropped.append(f)
+        else:
+            kept.append(f)
+    return kept, dropped
+
+
+def _load_sources(findings: List[Finding]) -> Dict[str, List[str]]:
+    sources: Dict[str, List[str]] = {}
+    for f in findings:
+        if f.path in sources:
+            continue
+        p = pathlib.Path(f.path)
+        sources[f.path] = p.read_text().splitlines() if p.is_file() else []
+    return sources
+
+
+def deep_lint(select: Optional[Set[str]] = None,
+              baseline_path: Optional[pathlib.Path] = None
+              ) -> Tuple[LintResult, DeepStats,
+                         List[Tuple[Finding, str]]]:
+    """The deep gate -> (result, stats, fingerprinted findings).
+
+    The fingerprinted list (finding, fingerprint) covers ALL deep
+    findings — the CLI folds it into ``--write-baseline`` /
+    ``--update-baseline`` so the deep layer shares the one baseline
+    file.
+    """
+    raw, stats = run_deep_rules(select)
+    sources = _load_sources(raw)
+    kept, suppressed = _filter_suppressed(raw, sources)
+    fingerprinted = baseline_mod.fingerprint_findings(kept, sources)
+
+    entries = baseline_mod.load_entries(baseline_path) if baseline_path \
+        else []
+    known = {e["fingerprint"] for e in entries}
+    new = [f for f, fp in fingerprinted if fp not in known]
+    baselined = [f for f, fp in fingerprinted if fp in known]
+
+    produced = {fp for _, fp in fingerprinted}
+    rule_ids = {r.id for r in _deep_rules(select)}
+    stale = {e["fingerprint"] for e in entries
+             if e["rule"] in rule_ids and e["fingerprint"] not in produced}
+    # semantic debt needs a recorded WHY: matched DP entries lacking one
+    rationale = baseline_mod.rationales(entries)
+    matched = {fp for _, fp in fingerprinted if fp in known}
+    stats.unrationalized = sorted(
+        e["fingerprint"] for e in entries
+        if e["fingerprint"] in matched and e["fingerprint"] not in rationale)
+
+    result = LintResult(new=new, baselined=baselined,
+                        suppressed=suppressed, stale_baseline=stale,
+                        parse_errors=[], files_checked=len(sources))
+    return result, stats, fingerprinted

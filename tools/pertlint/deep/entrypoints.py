@@ -1,0 +1,270 @@
+"""Registry of the package's real jit entry points, on canonical shapes.
+
+Each entry declares how to build ONE production program on abstract
+inputs: the jitted callable, the full positional/keyword arguments
+(statics included, dynamics as ``jax.ShapeDtypeStruct``), the dynamic
+argument names in positional order, and the donation the source
+declares.  The deep engine traces and lowers every entry — nothing
+executes — and runs DP001..DP005 over the results.
+
+Canonical geometry: small enough to trace in milliseconds, shaped like
+production — P=13 enumeration states, K=4 GC polynomial, and a cell/loci
+grid divisible by the 4x2 cells-x-loci parity mesh (MULTICHIP dryrun),
+so the same numbers anchor the DP006/DP007 divisibility checks.
+
+The two placement entries need 8 local devices (the forced-host CPU
+backend provides them; ``engine._ensure_cpu_devices`` sets the flag when
+the backend is not yet initialised).  When fewer devices exist they are
+skipped with a note rather than failing the gate — every other entry
+still runs on one device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, Dict, List, Optional, Tuple
+
+# one source of truth for the canonical trace geometry
+CANONICAL_DIMS: Dict[str, int] = {
+    "cells": 8,
+    "loci": 16,
+    "P": 13,
+    "K1": 5,   # K + 1 GC-polynomial features
+    "L": 1,
+}
+MESH_EXTENTS: Dict[str, int] = {"cells": 4, "loci": 2}
+
+MAX_ITER = 120
+MIN_ITER = 10
+DIAG_EVERY = 8
+LEARNING_RATE = 0.05
+B1, B2 = 0.8, 0.99
+
+
+class SkipEntry(RuntimeError):
+    """Raised by a builder when its prerequisites are absent (devices)."""
+
+
+@dataclasses.dataclass
+class EntryProgram:
+    """One buildable entry point, ready for ``trace.build_program_context``."""
+    name: str
+    anchor: object                 # python callable anchoring path/line
+    jit_fn: object                 # the jit-wrapped callable
+    args: tuple                    # full positional args (statics included)
+    kwargs: dict
+    dynamic_args: List[Tuple[str, object]]  # (name, value), positional order
+    declared_donate: Tuple[str, ...]
+
+
+def _model_pieces():
+    import jax
+
+    from scdna_replication_tools_tpu.models.pert import (
+        PertBatch,
+        PertModelSpec,
+        init_params,
+    )
+
+    spec = PertModelSpec(P=CANONICAL_DIMS["P"], K=CANONICAL_DIMS["K1"] - 1,
+                         L=CANONICAL_DIMS["L"])
+    batch = PertBatch.abstract(spec, CANONICAL_DIMS["cells"],
+                               CANONICAL_DIMS["loci"])
+    fixed: dict = {}
+    params = jax.eval_shape(functools.partial(init_params, spec), batch,
+                            fixed)
+    return spec, batch, fixed, params
+
+
+def _loss_fn(spec):
+    from scdna_replication_tools_tpu.infer.runner import _PertLossFn
+
+    return _PertLossFn(spec=spec)
+
+
+def build_loss() -> EntryProgram:
+    """The bare SVI objective: ``pert_loss`` via the runner's
+    value-hashable loss callable — the program differentiated inside
+    every fit."""
+    import jax
+
+    spec, batch, fixed, params = _model_pieces()
+    loss = _loss_fn(spec)
+    dynamic = [("params", params), ("fixed", fixed), ("batch", batch)]
+    return EntryProgram(name="loss", anchor=type(loss).__call__,
+                        jit_fn=jax.jit(loss),
+                        args=(params, fixed, batch), kwargs={},
+                        dynamic_args=dynamic, declared_donate=())
+
+
+def _fit_common():
+    import jax
+    import jax.numpy as jnp
+
+    from scdna_replication_tools_tpu.infer import svi
+
+    spec, batch, fixed, params = _model_pieces()
+    opt_state = jax.eval_shape(svi.make_opt_state, params)
+    S = jax.ShapeDtypeStruct
+    losses0 = S((MAX_ITER,), jnp.float32)
+    diag0 = S((svi.DIAG_RING, 3), jnp.float32)
+    i32 = S((), jnp.int32)
+    f32 = S((), jnp.float32)
+    loss_args = (fixed, batch)
+    return svi, _loss_fn(spec), params, opt_state, losses0, diag0, i32, \
+        f32, loss_args
+
+
+def build_fit() -> EntryProgram:
+    """The whole-budget fit program (``_run_fit``): one ``lax.while_loop``
+    per fit, every init buffer donated."""
+    (svi, loss, params, opt_state, losses0, diag0, i32, f32,
+     loss_args) = _fit_common()
+    args = (loss, params, opt_state, losses0, diag0, i32, loss_args,
+            MAX_ITER, MIN_ITER, f32, LEARNING_RATE, B1, B2, DIAG_EVERY)
+    dynamic = [("params0", params), ("opt_state0", opt_state),
+               ("losses0", losses0), ("diag0", diag0), ("i0", i32),
+               ("loss_args", loss_args), ("rel_tol", f32)]
+    return EntryProgram(name="fit", anchor=svi._run_fit,
+                        jit_fn=svi._run_fit, args=args, kwargs={},
+                        dynamic_args=dynamic,
+                        declared_donate=svi.FIT_DONATE_ARGNAMES)
+
+
+def build_fit_chunk() -> EntryProgram:
+    """The adaptive controller's chunk program (``_run_fit_chunk``):
+    dynamic bound/tolerances, consumed-on-entry carries donated,
+    ``params0`` deliberately NOT (the host keeps it as the best-loss
+    checkpoint — the documented exception DP003 baselines)."""
+    (svi, loss, params, opt_state, losses0, diag0, i32, f32,
+     loss_args) = _fit_common()
+    args = (loss, params, opt_state, losses0, diag0, i32, i32, i32, f32,
+            f32, loss_args, min(9, MAX_ITER), B1, B2, DIAG_EVERY)
+    dynamic = [("params0", params), ("opt_state0", opt_state),
+               ("losses0", losses0), ("diag0", diag0), ("i0", i32),
+               ("stop", i32), ("min_iter", i32), ("rel_tol", f32),
+               ("lr", f32), ("loss_args", loss_args)]
+    return EntryProgram(name="fit_chunk", anchor=svi._run_fit_chunk,
+                        jit_fn=svi._run_fit_chunk, args=args, kwargs={},
+                        dynamic_args=dynamic,
+                        declared_donate=svi.CHUNK_DONATE_ARGNAMES)
+
+
+def build_decode_slab() -> EntryProgram:
+    """One compiled decode pass with the posterior-confidence maps on —
+    the packaging/QC hot program."""
+    from scdna_replication_tools_tpu.models import pert
+
+    spec, batch, fixed, params = _model_pieces()
+    args = (spec, params, fixed, batch)
+    dynamic = [("params", params), ("fixed", fixed), ("batch", batch)]
+    return EntryProgram(name="decode_slab", anchor=pert._decode_slab,
+                        jit_fn=pert._decode_slab, args=args,
+                        kwargs={"want_entropy": True},
+                        dynamic_args=dynamic, declared_donate=())
+
+
+def build_ppc() -> EntryProgram:
+    """The posterior-predictive-check slab (``_ppc_slab``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from scdna_replication_tools_tpu.models import pert
+
+    spec, batch, fixed, params = _model_pieces()
+    S = jax.ShapeDtypeStruct
+    bins = (CANONICAL_DIMS["cells"], CANONICAL_DIMS["loci"])
+    cn_map = S(bins, jnp.int32)
+    rep_map = S(bins, jnp.int32)
+    key = S((2,), jnp.uint32)
+    args = (spec, params, fixed, batch, cn_map, rep_map, key)
+    dynamic = [("params", params), ("fixed", fixed), ("batch", batch),
+               ("cn_map", cn_map), ("rep_map", rep_map), ("key", key)]
+    return EntryProgram(name="ppc", anchor=pert._ppc_slab,
+                        jit_fn=pert._ppc_slab, args=args,
+                        kwargs={"num_replicates": 4},
+                        dynamic_args=dynamic, declared_donate=())
+
+
+def _placement_entry(name: str, anchor, specs: dict,
+                     values: dict) -> EntryProgram:
+    """A jit identity whose out_shardings place ``values`` per ``specs``
+    on the canonical mesh — the traced form of ``shard_batch`` /
+    ``shard_params``.  Lowering this program is what verifies the specs
+    are consistent with the declared ranks on a real mesh (XLA rejects a
+    rank-overflowing or unknown-axis sharding at lowering)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    from scdna_replication_tools_tpu.parallel.mesh import make_mesh
+
+    needed = MESH_EXTENTS["cells"] * MESH_EXTENTS["loci"]
+    if len(jax.devices()) < needed:
+        raise SkipEntry(f"{name}: needs {needed} devices, "
+                        f"{len(jax.devices())} available")
+    mesh = make_mesh(MESH_EXTENTS["cells"],
+                     loci_shards=MESH_EXTENTS["loci"])
+    shardings = {k: NamedSharding(mesh, specs[k]) for k in values}
+    jit_fn = jax.jit(lambda tree: tree, out_shardings=shardings)
+    return EntryProgram(name=name, anchor=anchor, jit_fn=jit_fn,
+                        args=(values,), kwargs={},
+                        dynamic_args=[("tree", values)],
+                        declared_donate=())
+
+
+def build_sharded_batch() -> EntryProgram:
+    """Batch placement on the 4x2 mesh: every present PertBatch field
+    against its ``layout.batch_specs`` PartitionSpec."""
+    from scdna_replication_tools_tpu import layout
+    from scdna_replication_tools_tpu.parallel import mesh as mesh_mod
+
+    spec, batch, fixed, params = _model_pieces()
+    specs = layout.batch_specs(layout.LOCI_AXIS)
+    values = {name: getattr(batch, name) for name in specs
+              if getattr(batch, name) is not None}
+    return _placement_entry("sharded_batch", mesh_mod.shard_batch, specs,
+                            values)
+
+
+def build_sharded_params() -> EntryProgram:
+    """Parameter placement on the 4x2 mesh: the full unconstrained
+    parameter pytree against ``layout.param_specs``."""
+    from scdna_replication_tools_tpu import layout
+    from scdna_replication_tools_tpu.parallel import mesh as mesh_mod
+
+    spec, batch, fixed, params = _model_pieces()
+    specs = layout.param_specs(layout.LOCI_AXIS)
+    return _placement_entry("sharded_params", mesh_mod.shard_params,
+                            specs, dict(params))
+
+
+REGISTRY: Dict[str, Callable[[], EntryProgram]] = {
+    "loss": build_loss,
+    "fit": build_fit,
+    "fit_chunk": build_fit_chunk,
+    "decode_slab": build_decode_slab,
+    "ppc": build_ppc,
+    "sharded_batch": build_sharded_batch,
+    "sharded_params": build_sharded_params,
+}
+
+
+def build_all(names: Optional[List[str]] = None
+              ) -> Tuple[List[EntryProgram], List[str]]:
+    """Build every (or the named) registered entry -> (built, skipped).
+
+    ``skipped`` carries human-readable reasons (currently only missing
+    devices for the placement entries); build ERRORS propagate — a gate
+    that cannot trace its programs must fail loudly, not shrink.
+    """
+    built: List[EntryProgram] = []
+    skipped: List[str] = []
+    for name, builder in REGISTRY.items():
+        if names is not None and name not in names:
+            continue
+        try:
+            built.append(builder())
+        except SkipEntry as exc:
+            skipped.append(str(exc))
+    return built, skipped

@@ -1,0 +1,194 @@
+"""DP001..DP005 — semantic rules over one traced program.
+
+Each rule reads a :class:`tools.pertlint.deep.trace.ProgramContext` —
+plain shapes/dtypes/strings, no jax objects — so this module imports
+nothing outside the stdlib and every rule is unit-testable with a
+hand-built context.  Findings anchor at the entry point's jit
+decoration line, where the contract being violated is declared.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from tools.pertlint.core import Finding, Rule, register
+from tools.pertlint.rules.donate import _INIT_VALUE
+
+#: dtypes that must never appear in a traced PERT program: the pipeline
+#: is f32-tuned end to end, and a single f64 intermediate doubles the
+#: HBM stream of everything it touches (or crashes outright on TPU).
+_WIDE_DTYPES = ("float64", "complex128")
+
+#: host-transfer primitives: each is a device->host round trip baked
+#: into a compiled program that the source-level PL001 can only guess at
+_CALLBACK_PRIMS = ("callback", "debug_callback", "io_callback",
+                   "pure_callback", "infeed", "outfeed")
+
+
+class DeepRule(Rule):
+    """Base of the jaxpr-level rules: ``check(ctx: ProgramContext)``."""
+
+    kind = "deep"
+    context = "program"
+
+    def at(self, ctx, message: str) -> Finding:
+        return Finding(rule=self.id, severity=self.severity, path=ctx.path,
+                       line=ctx.line, col=0,
+                       message=f"[{ctx.name}] {message}")
+
+
+@register
+class DtypePromotionAudit(DeepRule):
+    id = "DP001"
+    name = "dtype-promotion-audit"
+    severity = "error"
+    description = ("a traced program carries float64/complex128 values or "
+                   "silently narrows f32 work to bf16 — the semantic "
+                   "upgrade of the AST-level PL004 dtype guess")
+
+    def check(self, ctx) -> Iterable[Finding]:
+        wide = [a for a in ctx.var_avals if a.dtype in _WIDE_DTYPES]
+        wide += [a for a in ctx.out_avals if a.dtype in _WIDE_DTYPES]
+        if wide:
+            kinds = sorted({a.dtype for a in wide})
+            yield self.at(ctx, f"{len(wide)} {'/'.join(kinds)} value(s) in "
+                               f"the traced program — the pipeline is "
+                               f"f32-tuned; an x64 leak here doubles HBM "
+                               f"traffic (check jax_enable_x64 and literal "
+                               f"dtypes)")
+        narrowed = [(src, dst) for src, dst in ctx.converts
+                    if src.dtype == "float32" and dst == "bfloat16"]
+        if narrowed:
+            yield self.at(ctx, f"{len(narrowed)} convert_element_type "
+                               f"f32->bf16 — silent precision drop in a "
+                               f"program tuned for f32 accumulation; make "
+                               f"the cast explicit policy or remove it")
+
+
+@register
+class HostCallbackInProgram(DeepRule):
+    id = "DP002"
+    name = "host-callback-in-program"
+    severity = "error"
+    description = ("a host callback / debug print / infeed primitive is "
+                   "actually present in a traced program — each is a "
+                   "device->host sync per call (the semantic upgrade of "
+                   "PL001's source-level guess)")
+
+    def check(self, ctx) -> Iterable[Finding]:
+        for use in ctx.primitives:
+            if use.name in _CALLBACK_PRIMS:
+                yield self.at(ctx, f"primitive '{use.name}' x{use.count} in "
+                                   f"the traced program — a host round-trip "
+                                   f"inside compiled code (left-over "
+                                   f"jax.debug.print / pure_callback?)")
+
+
+@register
+class DonationAudit(DeepRule):
+    id = "DP003"
+    name = "donation-audit"
+    severity = "error"
+    description = ("declared donate_argnames that produce no "
+                   "input_output_alias in the lowered module (the PR-4 "
+                   "mirror-rescue aliasing bug class), undonated "
+                   "initial-value buffers, and donation typos")
+
+    def check(self, ctx) -> Iterable[Finding]:
+        # 1) donation typos: declared names that are not dynamic args
+        for name in ctx.declared_donate:
+            if name not in ctx.dynamic_arg_names:
+                yield self.at(ctx, f"donate_argnames names {name!r} but the "
+                                   f"program has no such dynamic argument — "
+                                   f"the donation silently does nothing")
+
+        # 2) donated-but-unaliased: XLA dropped the alias, so the caller
+        # believes the buffer is recycled while the program copies it
+        # (or worse, aliases live state — the PR-4 bug)
+        unaliased: dict = {}
+        for leaf in ctx.leaves:
+            if leaf.donated and leaf.aliased is False:
+                unaliased.setdefault(leaf.arg, []).append(leaf)
+        for arg, leaves in sorted(unaliased.items()):
+            total = sum(1 for l in ctx.leaves if l.arg == arg and l.donated)
+            yield self.at(ctx, f"argument {arg!r}: {len(leaves)} of {total} "
+                               f"donated leaves have NO input_output_alias "
+                               f"in the lowered module — the donation is "
+                               f"not happening (shape/dtype mismatch with "
+                               f"every output, or the buffer is still "
+                               f"live); first leaf: "
+                               f"{arg}{leaves[0].keypath} "
+                               f"{leaves[0].aval.shape}")
+        if not unaliased and ctx.donated_leaf_count \
+                and ctx.alias_count < ctx.donated_leaf_count:
+            # attribution failed (MLIR arg count mismatch): fall back to
+            # comparing totals so the audit cannot silently pass
+            yield self.at(ctx, f"{ctx.donated_leaf_count} leaves are "
+                               f"declared donated but only "
+                               f"{ctx.alias_count} input_output_aliases "
+                               f"exist in the lowered module")
+
+        # 3) undonated initial-value buffers: the jaxpr-level twin of
+        # PL007 — argument names following the *0/_init convention that
+        # the jit wrapping does not donate
+        for name in ctx.dynamic_arg_names:
+            if _INIT_VALUE.match(name) and name not in ctx.declared_donate:
+                nbytes = sum(l.aval.nbytes for l in ctx.leaves
+                             if l.arg == name)
+                yield self.at(ctx, f"initial-value argument {name!r} "
+                                   f"(~{nbytes} bytes at the canonical "
+                                   f"trace shape) is not donated — every "
+                                   f"call copies it on entry")
+
+
+@register
+class ConstantBloat(DeepRule):
+    id = "DP004"
+    name = "constant-bloat"
+    severity = "error"
+    description = ("a large literal is baked into the traced program as a "
+                   "closed-over constant — it is re-uploaded per program, "
+                   "bloats the executable, and defeats the program cache "
+                   "(equal fits stop being equal programs)")
+
+    THRESHOLD_BYTES = 1 << 20  # 1 MiB: far above any legit scalar table
+
+    def check(self, ctx) -> Iterable[Finding]:
+        for const in ctx.consts:
+            if const.nbytes > self.THRESHOLD_BYTES:
+                yield self.at(ctx, f"closed-over constant {const.shape} "
+                                   f"{const.dtype} ({const.nbytes} bytes) "
+                                   f"baked into the jaxpr — pass it as an "
+                                   f"argument so it lives once in HBM and "
+                                   f"the program stays cacheable")
+
+
+@register
+class WhileCarryConsistency(DeepRule):
+    id = "DP005"
+    name = "while-carry-consistency"
+    severity = "error"
+    description = ("a lax.while_loop carry slot whose init and body-output "
+                   "avals disagree (dtype/shape/weak-type) or that carries "
+                   "a weak type — the _fit_loop carry must be bit-stable "
+                   "across iterations or XLA inserts per-iteration casts")
+
+    def check(self, ctx) -> Iterable[Finding]:
+        for entry in ctx.while_carries:
+            init, out = entry.init, entry.body_out
+            if (init.shape, init.dtype) != (out.shape, out.dtype):
+                yield self.at(ctx, f"while carry slot {entry.position}: "
+                                   f"init {init.shape} {init.dtype} vs "
+                                   f"body output {out.shape} {out.dtype} — "
+                                   f"the loop re-lays-out its carry every "
+                                   f"iteration")
+            elif init.weak_type != out.weak_type:
+                yield self.at(ctx, f"while carry slot {entry.position}: "
+                                   f"weak-type flip between init "
+                                   f"({init.weak_type}) and body output "
+                                   f"({out.weak_type})")
+            elif init.weak_type:
+                yield self.at(ctx, f"while carry slot {entry.position} is "
+                                   f"weakly typed ({init.dtype}) — a "
+                                   f"Python scalar leaked into the carry; "
+                                   f"pin it with jnp.asarray(..., dtype)")

@@ -1,0 +1,112 @@
+"""DP006/DP007 — the tensor-layout contract, machine-checked.
+
+``layout.py`` calls itself the "single owner of the tensor-layout
+contract"; these rules make that a checked invariant instead of a
+docstring.  The engine resolves ``layout.contract_entries`` against the
+canonical mesh (``entrypoints.MESH_EXTENTS``) and shapes
+(``entrypoints.CANONICAL_DIMS``) into a :class:`trace.ContractContext`
+of plain tuples; the core checker (:func:`check_spec_against_shape`) is
+pure data-in/data-out so every failure mode has a direct unit test.
+
+Findings anchor at the producing factory's def line in ``layout.py``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterable, List, Tuple
+
+from tools.pertlint.core import Finding, register
+from tools.pertlint.deep.rules_jaxpr import DeepRule
+
+# problem codes the pure checker emits; DP006 and DP007 split them
+RANK = "rank-overflow"
+UNKNOWN = "unknown-axis"
+REUSE = "axis-reuse"
+INDIVISIBLE = "indivisible"
+
+
+def check_spec_against_shape(spec: Tuple[Tuple[str, ...], ...],
+                             spec_rank: int,
+                             shape: Tuple[int, ...],
+                             axis_extents: dict
+                             ) -> List[Tuple[str, str]]:
+    """Validate one normalised PartitionSpec against one array shape.
+
+    ``spec`` is the per-dim tuple-of-axis-names form
+    (``trace._normalise_spec``); ``spec_rank`` the raw PartitionSpec
+    length (trailing ``None`` entries count — a rank-overflowing spec is
+    a bug even when the overflow dims are unsharded, because it means
+    the factory believes the tensor has a different rank than it does).
+    Returns ``(code, message)`` problems; empty = the contract holds.
+    """
+    problems: List[Tuple[str, str]] = []
+    if spec_rank > len(shape):
+        problems.append((RANK,
+                         f"spec rank {spec_rank} exceeds array rank "
+                         f"{len(shape)} (shape {shape})"))
+    used: dict = {}
+    for d, axes in enumerate(spec[:len(shape)]):
+        for ax in axes:
+            if ax not in axis_extents:
+                problems.append((UNKNOWN,
+                                 f"dim {d} names mesh axis {ax!r} but the "
+                                 f"mesh axes are "
+                                 f"{sorted(axis_extents)}"))
+            if ax in used:
+                problems.append((REUSE,
+                                 f"mesh axis {ax!r} appears on dim {d} and "
+                                 f"dim {used[ax]} — an axis can shard at "
+                                 f"most one dim"))
+            used.setdefault(ax, d)
+        extent = math.prod(axis_extents.get(ax, 1) for ax in axes)
+        if extent > 1 and shape[d] % extent != 0:
+            problems.append((INDIVISIBLE,
+                             f"dim {d} (size {shape[d]}) is not divisible "
+                             f"by its mesh extent {extent} "
+                             f"({'*'.join(axes)}) — uneven shards mean "
+                             f"per-device padding XLA hides until OOM/"
+                             f"wrong-answer territory"))
+    return problems
+
+
+class ContractRule(DeepRule):
+    """Base of the contract rules: ``check(ctx: ContractContext)``."""
+
+    context = "contract"
+    CODES: Tuple[str, ...] = ()
+
+    def at_row(self, ctx, row, message: str) -> Finding:
+        return Finding(rule=self.id, severity=self.severity, path=ctx.path,
+                       line=row.line, col=0,
+                       message=f"[{row.tensor}] {message}")
+
+    def check(self, ctx) -> Iterable[Finding]:
+        for row in ctx.rows:
+            for code, msg in check_spec_against_shape(
+                    row.spec, row.spec_rank, row.shape, ctx.axis_extents):
+                if code in self.CODES:
+                    yield self.at_row(ctx, row, msg)
+
+
+@register
+class ShardingContract(ContractRule):
+    id = "DP006"
+    name = "sharding-contract"
+    severity = "error"
+    description = ("a layout.py PartitionSpec factory violates the mesh "
+                   "contract: spec rank exceeds the declared tensor rank, "
+                   "names an unknown mesh axis, or reuses a mesh axis "
+                   "across dims")
+    CODES = (RANK, UNKNOWN, REUSE)
+
+
+@register
+class ShardingDivisibility(ContractRule):
+    id = "DP007"
+    name = "sharding-divisibility"
+    severity = "error"
+    description = ("a declared tensor dim is not divisible by the mesh "
+                   "extent its PartitionSpec shards it over (canonical "
+                   "shapes vs the 4x2 parity mesh)")
+    CODES = (INDIVISIBLE,)

@@ -1,0 +1,330 @@
+"""Turn a registered entry point into the facts the deep rules consume.
+
+Everything jax-flavoured happens HERE (and in ``entrypoints``): the rule
+modules receive plain dataclasses of shapes/dtypes/strings, so they stay
+stdlib-importable and their verdicts are trivially unit-testable with
+hand-built contexts.
+
+Nothing is ever executed: programs are traced (``.trace()``) and lowered
+(``.lower()``) on abstract ``ShapeDtypeStruct`` arguments.  Donation
+facts come from two independent sources that the DP003 audit compares —
+the *declared* ``donate_argnames`` (via ``Lowered.args_info``) and the
+*realised* ``tf.aliasing_output`` argument attributes of the lowered
+StableHLO module.  A donated argument the lowering could not alias is
+exactly the PR-4 mirror-rescue bug class (the donated buffer was silently
+copied; worse, the caller believed it was dead while it aliased live
+state).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+import math
+import pathlib
+import re
+from typing import List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class AvalInfo:
+    """Shape/dtype/weak-type of one abstract value, jax-free."""
+    shape: Tuple[int, ...]
+    dtype: str
+    weak_type: bool = False
+
+    @property
+    def nbytes(self) -> int:
+        import numpy as np
+
+        return math.prod(self.shape) * np.dtype(self.dtype).itemsize
+
+
+@dataclasses.dataclass(frozen=True)
+class LeafInfo:
+    """One flattened dynamic-argument leaf of a lowered program."""
+    arg: str              # top-level dynamic argument name ("opt_state0")
+    keypath: str          # pytree path inside that argument
+    aval: AvalInfo
+    donated: bool
+    aliased: Optional[bool]   # None: MLIR positions could not be mapped
+
+
+@dataclasses.dataclass(frozen=True)
+class WhileCarryEntry:
+    """One carry slot of a ``while`` eqn: init aval vs body-output aval."""
+    position: int
+    init: AvalInfo
+    body_out: AvalInfo
+
+
+@dataclasses.dataclass(frozen=True)
+class PrimitiveUse:
+    name: str
+    count: int
+
+
+@dataclasses.dataclass
+class ProgramContext:
+    """Everything DP001..DP005 need about one traced entry point."""
+    name: str                 # registry name ("fit_chunk")
+    path: str                 # repo-relative posix path of the anchor
+    line: int                 # anchor line (the jit decoration/def)
+    primitives: List[PrimitiveUse]
+    out_avals: List[AvalInfo]
+    var_avals: List[AvalInfo]         # every eqn output var, all sub-jaxprs
+    converts: List[Tuple[AvalInfo, str]]   # convert_element_type: (in, out dtype)
+    consts: List[AvalInfo]            # closed-over constants
+    leaves: List[LeafInfo]
+    declared_donate: Tuple[str, ...]
+    dynamic_arg_names: Tuple[str, ...]
+    while_carries: List[WhileCarryEntry]
+    alias_count: int                  # tf.aliasing_output attrs in the MLIR
+    donated_leaf_count: int
+
+
+@dataclasses.dataclass
+class ContractRow:
+    """One layout-contract row, normalised to plain data."""
+    tensor: str
+    factory: str
+    spec: Tuple[Tuple[str, ...], ...]   # per-dim tuple of mesh axis names
+    spec_rank: int
+    shape: Tuple[int, ...]
+    line: int                           # factory's def line in layout.py
+
+
+@dataclasses.dataclass
+class ContractContext:
+    """The whole layout contract against one canonical mesh."""
+    path: str                 # repo-relative path of layout.py
+    axis_extents: dict        # mesh axis name -> extent
+    rows: List[ContractRow]
+
+
+def repo_relpath(p: str) -> str:
+    """Path relative to the CWD (the repo root in CI) when possible —
+    findings and baseline fingerprints must match how the AST layer
+    reports paths."""
+    path = pathlib.Path(p).resolve()
+    try:
+        return path.relative_to(pathlib.Path.cwd().resolve()).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def anchor_of(fn) -> Tuple[str, int]:
+    """(path, line) of a callable's definition, unwrapping jit wrappers.
+
+    ``co_firstlineno`` of a decorated function is its first decorator
+    line — exactly where a donation/static declaration lives.
+    """
+    while hasattr(fn, "__wrapped__"):
+        fn = fn.__wrapped__
+    code = getattr(fn, "__code__", None)
+    if code is not None:
+        return repo_relpath(code.co_filename), code.co_firstlineno
+    # class instances (the value-hashable loss callables): anchor at the
+    # class definition
+    cls = type(fn)
+    path = inspect.getsourcefile(cls)
+    _, line = inspect.getsourcelines(cls)
+    return repo_relpath(path), line
+
+
+def _aval_info(aval) -> AvalInfo:
+    return AvalInfo(shape=tuple(int(d) for d in getattr(aval, "shape", ())),
+                    dtype=str(getattr(aval, "dtype", "")),
+                    weak_type=bool(getattr(aval, "weak_type", False)))
+
+
+def _sub_jaxprs(params: dict):
+    for v in params.values():
+        for cand in (v if isinstance(v, (list, tuple)) else (v,)):
+            inner = getattr(cand, "jaxpr", None)
+            if inner is None:
+                continue
+            # ClosedJaxpr.jaxpr -> Jaxpr (has .outvars); unwrap once more
+            # if a doubly-closed jaxpr ever shows up
+            yield inner if hasattr(inner, "outvars") else inner.jaxpr
+
+
+def iter_eqns(jaxpr):
+    """Depth-first over every eqn of ``jaxpr`` and all sub-jaxprs
+    (while/cond/scan bodies, custom-derivative closures, ...)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn.params):
+            yield from iter_eqns(sub)
+
+
+def _while_carry_entries(eqn) -> List[WhileCarryEntry]:
+    """Init-vs-body avals of one ``while`` eqn's carry slots."""
+    cond_n = int(eqn.params.get("cond_nconsts", 0))
+    body_n = int(eqn.params.get("body_nconsts", 0))
+    carry_in = eqn.invars[cond_n + body_n:]
+    body = eqn.params["body_jaxpr"]
+    # ClosedJaxpr proxies .eqns but not .outvars — unwrap on that
+    body_jaxpr = body if hasattr(body, "outvars") else body.jaxpr
+    out = list(body_jaxpr.outvars)
+    entries = []
+    for i, (iv, ov) in enumerate(zip(carry_in, out)):
+        entries.append(WhileCarryEntry(position=i, init=_aval_info(iv.aval),
+                                       body_out=_aval_info(ov.aval)))
+    return entries
+
+
+_MAIN_SIG = re.compile(r"func\.func public @main\((.*?)\)\s*->", re.S)
+_MAIN_ARG = re.compile(r"%arg(\d+): [^,)]+?(\{[^{}]*\})?(?=,|$|\))")
+
+
+def parse_alias_positions(mlir_text: str
+                          ) -> Tuple[Optional[int], frozenset]:
+    """(argument count, positions carrying ``tf.aliasing_output``) of the
+    lowered module's public main — None count when the signature could
+    not be located (alias attribution then degrades to counting)."""
+    m = _MAIN_SIG.search(mlir_text)
+    if not m:
+        return None, frozenset()
+    sig = m.group(1)
+    positions = set()
+    count = 0
+    for am in _MAIN_ARG.finditer(sig):
+        count += 1
+        if am.group(2) and "tf.aliasing_output" in am.group(2):
+            positions.add(int(am.group(1)))
+    return count, frozenset(positions)
+
+
+def build_program_context(prog) -> ProgramContext:
+    """Trace + lower one ``entrypoints.EntryProgram`` into plain facts."""
+    import collections
+
+    import jax
+
+    traced = prog.jit_fn.trace(*prog.args, **prog.kwargs)
+    closed = traced.jaxpr
+    # Traced.lower() reuses the trace above; fn.lower() would re-trace
+    # the whole program (the fit while_loop twice per gate run)
+    lowered = traced.lower() if hasattr(traced, "lower") \
+        else prog.jit_fn.lower(*prog.args, **prog.kwargs)
+    text = lowered.as_text()
+
+    # --- jaxpr walk -------------------------------------------------------
+    prim_counts = collections.Counter()
+    var_avals: List[AvalInfo] = []
+    converts: List[Tuple[AvalInfo, str]] = []
+    while_carries: List[WhileCarryEntry] = []
+    for eqn in iter_eqns(closed.jaxpr):
+        prim_counts[eqn.primitive.name] += 1
+        for ov in eqn.outvars:
+            var_avals.append(_aval_info(ov.aval))
+        if eqn.primitive.name == "convert_element_type":
+            converts.append((_aval_info(eqn.invars[0].aval),
+                             str(eqn.params.get("new_dtype", ""))))
+    # carry consistency is checked for TOP-LEVEL while loops only: those
+    # are the package's own fit loops (the _fit_loop lineage).  Nested
+    # whiles belong to jax library internals (e.g. jax.random.gamma's
+    # rejection sampler carries a weak int on purpose) — flagging them
+    # would make the gate track upstream implementation details.
+    for eqn in closed.jaxpr.eqns:
+        if eqn.primitive.name == "while":
+            while_carries.extend(_while_carry_entries(eqn))
+
+    # closed-over constants are concrete arrays: read shape/dtype directly
+    consts = [AvalInfo(shape=tuple(int(d)
+                                   for d in getattr(c, "shape", ())),
+                       dtype=str(getattr(c, "dtype", "")))
+              for c in closed.consts]
+
+    # --- argument leaves: declared donation vs realised aliasing ----------
+    is_leaf = lambda x: hasattr(x, "donated")  # noqa: E731
+    flat, _ = jax.tree_util.tree_flatten_with_path(lowered.args_info,
+                                                   is_leaf=is_leaf)
+    arg_count, aliased_pos = parse_alias_positions(text)
+    attribute = arg_count is not None and arg_count == len(flat)
+
+    # map each flat leaf to its top-level dynamic argument by leaf count
+    names_by_leaf: List[Tuple[str, str]] = []
+    for name, value in prog.dynamic_args:
+        leaves = jax.tree_util.tree_flatten_with_path(
+            value, is_leaf=lambda x: hasattr(x, "shape"))[0]
+        for kp, _ in leaves:
+            names_by_leaf.append((name, jax.tree_util.keystr(kp)))
+    aligned = len(names_by_leaf) == len(flat)
+
+    leaf_infos: List[LeafInfo] = []
+    for i, (kp, info) in enumerate(flat):
+        arg, sub = (names_by_leaf[i] if aligned
+                    else (jax.tree_util.keystr(kp), ""))
+        leaf_infos.append(LeafInfo(
+            arg=arg, keypath=sub,
+            aval=AvalInfo(shape=tuple(int(d) for d in info.shape),
+                          dtype=str(info.dtype)),
+            donated=bool(info.donated),
+            aliased=(i in aliased_pos) if attribute else None))
+
+    path, line = anchor_of(prog.anchor)
+    return ProgramContext(
+        name=prog.name, path=path, line=line,
+        primitives=[PrimitiveUse(n, c)
+                    for n, c in sorted(prim_counts.items())],
+        out_avals=[_aval_info(a) for a in closed.out_avals],
+        var_avals=var_avals,
+        converts=converts,
+        consts=consts,
+        leaves=leaf_infos,
+        declared_donate=tuple(prog.declared_donate),
+        dynamic_arg_names=tuple(n for n, _ in prog.dynamic_args),
+        while_carries=while_carries,
+        alias_count=len(aliased_pos),
+        donated_leaf_count=sum(1 for l in leaf_infos if l.donated),
+    )
+
+
+def _normalise_spec(spec) -> Tuple[Tuple[str, ...], ...]:
+    """PartitionSpec -> per-dim tuples of axis names (empty = unsharded)."""
+    out = []
+    for entry in tuple(spec):
+        if entry is None:
+            out.append(())
+        elif isinstance(entry, (tuple, list)):
+            out.append(tuple(str(e) for e in entry))
+        else:
+            out.append((str(entry),))
+    return tuple(out)
+
+
+def build_contract_context(canonical_dims: dict,
+                           mesh_extents: dict) -> ContractContext:
+    """The layout contract, resolved to concrete shapes + extents.
+
+    ``canonical_dims`` maps the symbolic dim names of
+    ``layout.contract_entries`` ("cells"/"loci"/"P"/"K1"/"L") to the
+    registry's canonical sizes; ``mesh_extents`` maps mesh axis names to
+    shard counts (the 4x2 parity-mesh default lives in ``entrypoints``).
+    """
+    import inspect as _inspect
+
+    from scdna_replication_tools_tpu import layout
+    from scdna_replication_tools_tpu.parallel import mesh as mesh_mod
+
+    mesh = mesh_mod.abstract_mesh(mesh_extents.get(layout.CELLS_AXIS, 1),
+                                  mesh_extents.get(layout.LOCI_AXIS, 1))
+    factory_lines = {}
+    rows: List[ContractRow] = []
+    for entry in layout.contract_entries(mesh):
+        if entry.factory not in factory_lines:
+            fn = getattr(layout, entry.factory)
+            factory_lines[entry.factory] = \
+                _inspect.getsourcelines(fn)[1]
+        shape = tuple(canonical_dims[d] for d in entry.dims)
+        rows.append(ContractRow(
+            tensor=entry.tensor, factory=entry.factory,
+            spec=_normalise_spec(entry.spec),
+            spec_rank=len(tuple(entry.spec)),
+            shape=shape,
+            line=factory_lines[entry.factory]))
+    return ContractContext(
+        path=repo_relpath(_inspect.getsourcefile(layout)),
+        axis_extents=dict(mesh_extents), rows=rows)

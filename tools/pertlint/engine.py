@@ -60,10 +60,27 @@ class LintResult:
     stale_baseline: Set[str]            # fingerprints no tree finding matches
     parse_errors: List[Tuple[str, str]]  # (path, message)
     files_checked: int = 0
+    missing_files: List[str] = dataclasses.field(default_factory=list)
+    # baseline entries pointing at files that no longer exist (dead
+    # weight a lint run can never match) — the CLI warns on these
 
     @property
     def gating(self) -> List[Finding]:
         return [f for f in self.new if f.severity == "error"]
+
+    def merge(self, other: "LintResult") -> "LintResult":
+        """Combine two passes (the AST layer + the deep layer) into the
+        single result the CLI reports and gates on."""
+        return LintResult(
+            new=self.new + other.new,
+            baselined=self.baselined + other.baselined,
+            suppressed=self.suppressed + other.suppressed,
+            stale_baseline=self.stale_baseline | other.stale_baseline,
+            parse_errors=self.parse_errors + other.parse_errors,
+            files_checked=self.files_checked + other.files_checked,
+            missing_files=sorted(set(self.missing_files)
+                                 | set(other.missing_files)),
+        )
 
 
 def iter_python_files(paths: Sequence[str]) -> List[pathlib.Path]:
@@ -105,7 +122,9 @@ def lint_paths(paths: Sequence[str],
                baseline_path: Optional[pathlib.Path] = None,
                rules: Optional[Sequence[Rule]] = None) -> LintResult:
     rules = list(rules) if rules is not None else all_rules()
-    known = baseline_mod.load(baseline_path) if baseline_path else set()
+    entries = baseline_mod.load_entries(baseline_path) if baseline_path \
+        else []
+    known = {e["fingerprint"] for e in entries}
 
     findings: List[Finding] = []
     suppressed: List[Finding] = []
@@ -127,10 +146,21 @@ def lint_paths(paths: Sequence[str],
     fingerprinted = baseline_mod.fingerprint_findings(findings, sources)
     new = [f for f, fp in fingerprinted if fp not in known]
     baselined = [f for f, fp in fingerprinted if fp in known]
-    stale = known - {fp for _, fp in fingerprinted}
+    # staleness is scoped to what this run could have produced: only
+    # entries whose rule actually ran AND whose path was covered can be
+    # declared stale — linting one file must not mark the rest of the
+    # grandfathered debt stale, and an AST-only run must not flag the
+    # deep (DP) layer's entries
+    rule_ids = {r.id for r in rules}
+    produced = {fp for _, fp in fingerprinted}
+    stale = {e["fingerprint"] for e in entries
+             if e["rule"] in rule_ids and _covered_by(e["path"], paths)
+             and e["fingerprint"] not in produced}
+    missing = sorted({e["path"] for e in baseline_mod.missing_file_entries(
+        entries, baseline_path)})
     return LintResult(new=new, baselined=baselined, suppressed=suppressed,
                       stale_baseline=stale, parse_errors=parse_errors,
-                      files_checked=len(files))
+                      files_checked=len(files), missing_files=missing)
 
 
 def _covered_by(entry_path: str, roots: Sequence[str]) -> bool:
@@ -145,13 +175,24 @@ def _covered_by(entry_path: str, roots: Sequence[str]) -> bool:
 
 def snapshot_baseline(paths: Sequence[str],
                       baseline_path: pathlib.Path,
-                      rules: Optional[Sequence[Rule]] = None) -> int:
+                      rules: Optional[Sequence[Rule]] = None,
+                      extra_fingerprinted: Optional[
+                          List[Tuple[Finding, str]]] = None,
+                      extra_rule_ids: Optional[Set[str]] = None) -> int:
     """Write the baseline from the tree's current findings; -> count.
 
-    Entries for paths OUTSIDE ``paths`` are retained untouched, so a
-    partial-tree snapshot grandfathers new findings without silently
-    dropping the rest of the debt (entries under ``paths`` are fully
-    rebuilt — that is what prunes stale ones).
+    Entries for paths OUTSIDE ``paths`` — or produced by rules this run
+    did not execute (the deep DP layer when only the AST pass ran) — are
+    retained untouched, so a partial snapshot grandfathers new findings
+    without silently dropping the rest of the debt.  Entries covered by
+    the executed rules — path-scoped for the AST layer, program-scoped
+    (path-independent) for the deep layer — are fully rebuilt: that is
+    what prunes stale ones.  Rationales survive re-snapshotting (matched
+    by fingerprint).  ``extra_fingerprinted``/``extra_rule_ids`` fold
+    another pass's findings and its FULL executed-rule set (the deep
+    layer's) into the same snapshot; the ids must come from the rule
+    registry, not from the findings, or a deep rule that went clean
+    would leave its stale entries behind.
     """
     rules = list(rules) if rules is not None else all_rules()
     findings: List[Finding] = []
@@ -166,7 +207,71 @@ def snapshot_baseline(paths: Sequence[str],
         sources[path] = source.splitlines()
         findings.extend(kept)
     fingerprinted = baseline_mod.fingerprint_findings(findings, sources)
-    retained = [e for e in baseline_mod.load_entries(baseline_path)
-                if not _covered_by(e["path"], paths)]
-    baseline_mod.write(baseline_path, fingerprinted, retained)
+    fingerprinted += list(extra_fingerprinted or [])
+    ast_rule_ids = {r.id for r in rules}
+    deep_rule_ids = set(extra_rule_ids or ()) \
+        | {f.rule for f, _ in (extra_fingerprinted or [])}
+    prior = baseline_mod.load_entries(baseline_path)
+    retained = [e for e in prior
+                if e["rule"] not in deep_rule_ids
+                and (e["rule"] not in ast_rule_ids
+                     or not _covered_by(e["path"], paths))]
+    baseline_mod.write(baseline_path, fingerprinted, retained,
+                       keep_rationales=baseline_mod.rationales(prior))
     return len(fingerprinted) + len(retained)
+
+
+def update_baseline(paths: Sequence[str],
+                    baseline_path: pathlib.Path,
+                    rules: Optional[Sequence[Rule]] = None,
+                    extra_produced: Optional[Set[str]] = None,
+                    extra_rule_ids: Optional[Set[str]] = None
+                    ) -> Tuple[int, int]:
+    """Prune-only baseline hygiene -> (kept, pruned).
+
+    Drops entries that are (a) stale — their rule ran over their
+    (covered) path and the fingerprint was not produced — or (b) dead —
+    their file no longer exists on disk (whatever the path coverage: a
+    deleted file can never match again).  NEVER adds entries, so new
+    findings keep gating; rationales of surviving entries are untouched.
+    ``extra_produced``/``extra_rule_ids`` fold in another pass's
+    fingerprints (the deep layer's) so its entries are pruned by the
+    same rule.
+    """
+    rules = list(rules) if rules is not None else all_rules()
+    findings: List[Finding] = []
+    sources: Dict[str, List[str]] = {}
+    for f in iter_python_files(paths):
+        path = f.as_posix()
+        try:
+            source = f.read_text()
+            kept, _ = lint_source(source, path=path, rules=rules)
+        except (SyntaxError, UnicodeDecodeError):
+            continue
+        sources[path] = source.splitlines()
+        findings.extend(kept)
+    produced = {fp for _, fp in
+                baseline_mod.fingerprint_findings(findings, sources)}
+    produced |= set(extra_produced or ())
+    ast_rule_ids = {r.id for r in rules}
+    deep_rule_ids = set(extra_rule_ids or ())
+
+    entries = baseline_mod.load_entries(baseline_path)
+    keep: List[dict] = []
+    pruned = 0
+    for e in entries:
+        gone = e["fingerprint"] not in produced
+        dead = not baseline_mod.entry_file_exists(e.get("path", ""),
+                                                  baseline_path)
+        # AST entries are path-scoped (only a covered path could have
+        # re-produced them); deep entries are program-scoped — if the
+        # deep rules ran at all, an unproduced entry is stale
+        stale = gone and (
+            (e["rule"] in ast_rule_ids and _covered_by(e["path"], paths))
+            or e["rule"] in deep_rule_ids)
+        if dead or stale:
+            pruned += 1
+        else:
+            keep.append(e)
+    baseline_mod.write(baseline_path, [], keep)
+    return len(keep), pruned

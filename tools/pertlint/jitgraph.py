@@ -15,8 +15,11 @@ computes, per file, the set of function nodes that are *traced-reachable*:
 The analysis is deliberately module-local — cross-module call graphs
 buy little here (the package's jit entry points wrap same-module helpers)
 and would make the tool's verdicts hard to predict for a reader of one
-file.  ``static_argnames`` of the jit decoration are recorded so rules
-can exempt Python-level arguments (``float(max_iter)`` is not a sync).
+file.  ``static_argnames`` AND ``static_argnums`` of the jit decoration
+are recorded (argnums resolved against the wrapped function's positional
+parameter list) so rules can exempt Python-level arguments
+(``float(max_iter)`` is not a sync, whether the argument is static by
+name or by position).
 """
 
 from __future__ import annotations
@@ -57,19 +60,53 @@ def _static_argnames(call: ast.Call) -> Set[str]:
     return names
 
 
-def _wrapper_call_info(call: ast.Call) -> Optional[Set[str]]:
-    """If ``call`` builds a jit/shard_map wrapper, its static argnames.
+def _static_argnums(call: ast.Call) -> Set[int]:
+    """Integer positions of ``static_argnums`` (single int or tuple)."""
+    nums: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, int) \
+                        and not isinstance(node.value, bool):
+                    nums.add(node.value)
+    return nums
+
+
+def _wrapper_call_info(call: ast.Call) -> Optional[Tuple[Set[str], Set[int]]]:
+    """If ``call`` builds a jit/shard_map wrapper, its static arguments
+    as ``(static_argnames, static_argnums)``.
 
     Matches ``jax.jit(...)``, ``shard_map(...)`` and the decorator-factory
     spelling ``functools.partial(jax.jit, ...)``.  Returns None when the
-    call is unrelated.
+    call is unrelated.  ``static_argnums`` are positional indices; the
+    caller resolves them against the wrapped function's parameter list
+    (``resolve_static_argnums``) so positionally-static args get the same
+    exemption as named ones.
     """
     if is_wrapper_expr(call.func):
-        return _static_argnames(call)
+        return _static_argnames(call), _static_argnums(call)
     if _tail_name(call.func) == "partial" and call.args \
             and is_wrapper_expr(call.args[0]):
-        return _static_argnames(call)
+        return _static_argnames(call), _static_argnums(call)
     return None
+
+
+def positional_param_names(func: ast.AST) -> List[str]:
+    """The wrapped function's positional parameters, in argnum order."""
+    a = func.args
+    return [arg.arg for arg in list(a.posonlyargs) + list(a.args)]
+
+
+def resolve_static_argnums(func: ast.AST, nums: Set[int]) -> Set[str]:
+    """Map ``static_argnums`` positions onto ``func``'s parameter names.
+
+    Out-of-range (and negative) indices resolve to nothing — a jit with a
+    bad argnum fails at runtime anyway, and guessing would silently
+    exempt the wrong parameter.
+    """
+    names = positional_param_names(func)
+    return {names[i] for i in nums if 0 <= i < len(names)}
 
 
 @dataclasses.dataclass
@@ -137,12 +174,14 @@ def compute_traced(tree: ast.Module) -> TracedInfo:
         for dec in f.decorator_list:
             statics = None
             if is_wrapper_expr(dec):
-                statics = set()
+                statics = (set(), set())
             elif isinstance(dec, ast.Call):
                 statics = _wrapper_call_info(dec)
             if statics is not None:
+                names, nums = statics
                 traced.add(f)
-                static_names.setdefault(f, set()).update(statics)
+                static_names.setdefault(f, set()).update(
+                    names | resolve_static_argnums(f, nums))
 
     # 2) call-site wrapping: jax.jit(f) / shard_map(f, ...) anywhere
     for node in ast.walk(tree):
@@ -151,11 +190,13 @@ def compute_traced(tree: ast.Module) -> TracedInfo:
         statics = _wrapper_call_info(node)
         if statics is None:
             continue
+        names, nums = statics
         for arg in node.args:
             name = arg.id if isinstance(arg, ast.Name) else None
             for f in by_name.get(name, []):
                 traced.add(f)
-                static_names.setdefault(f, set()).update(statics)
+                static_names.setdefault(f, set()).update(
+                    names | resolve_static_argnums(f, nums))
 
     # 3) lexical nesting: functions defined inside a traced function
     #    (iterate until stable; nesting can be several levels deep)
