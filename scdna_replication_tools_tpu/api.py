@@ -36,6 +36,13 @@ class scRT:
     same keyword surface; TPU-execution extras: ``backend``, ``num_shards``,
     ``cell_chunk``, ``checkpoint_dir``, ``compile_cache_dir`` (persistent
     XLA compilation cache — 'auto' = repo-local, None disables);
+    durable-run knobs (see OBSERVABILITY.md "Durable runs & resume"):
+    ``resume`` ('auto' restores fingerprint-verified checkpoints and
+    resumes in-flight fits mid-budget; 'force'/'off'),
+    ``checkpoint_every`` (periodic in-fit checkpoint cadence in
+    controller chunks), ``faults`` (deterministic fault-injection spec,
+    chaos-testing only) and ``watchdog_compile_seconds`` /
+    ``watchdog_chunk_seconds`` (per-phase hang deadlines);
     ``telemetry_path`` (structured JSONL run log, 'auto' = repo-local
     ``.pert_runs/``; the written path is surfaced as
     ``scRT.run_log_path`` — see OBSERVABILITY.md) with
@@ -72,6 +79,9 @@ class scRT:
                  cuda=False, seed=0, P=13, K=4, J=5, upsilon=6,
                  run_step3=True, backend='jax', num_shards=1,
                  loci_shards=1, cell_chunk=None, checkpoint_dir=None,
+                 resume='auto', checkpoint_every=4, faults=None,
+                 watchdog_compile_seconds=None,
+                 watchdog_chunk_seconds=None,
                  enum_impl='auto', cn_hmm_self_prob=None,
                  rho_from_rt_prior=False, mirror_rescue=True,
                  compile_cache_dir='auto', telemetry_path='auto',
@@ -108,7 +118,11 @@ class scRT:
             min_iter_step3=min_iter_step3, run_step3=run_step3, seed=seed,
             num_shards=num_shards, loci_shards=loci_shards,
             cell_chunk=cell_chunk,
-            checkpoint_dir=checkpoint_dir, enum_impl=enum_impl,
+            checkpoint_dir=checkpoint_dir, resume=resume,
+            checkpoint_every=checkpoint_every, faults=faults,
+            watchdog_compile_seconds=watchdog_compile_seconds,
+            watchdog_chunk_seconds=watchdog_chunk_seconds,
+            enum_impl=enum_impl,
             cn_hmm_self_prob=cn_hmm_self_prob,
             rho_from_rt_prior=rho_from_rt_prior,
             mirror_rescue=mirror_rescue,
@@ -252,9 +266,12 @@ class scRT:
                 qc_collect=qc_collect,
                 qc_entropy_thresh=self.config.qc_entropy_thresh)
 
-            if qc_collect is not None:
+            if qc_collect is not None and not qc_collect.get("degraded"):
                 # the PPC pass + QC table + cell_qc_summary event, inside
-                # the telemetry session so the artifact carries it
+                # the telemetry session so the artifact carries it.  A
+                # 'degraded' marker means the packaging decode's OOM
+                # ladder dropped the entropy surfaces — the QC table
+                # has no inputs then (the drop is audited in the log)
                 self._cell_qc_df = inference.build_cell_qc(
                     step2, inference._step2_data, qc_collect, timer=timer)
 

@@ -40,6 +40,27 @@ def infer_scrt_main(argv=None):
                    help="clone-discovery algorithm used when "
                         "--clone-col none")
     p.add_argument("--num-shards", type=int, default=1)
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="write step-boundary + periodic in-fit "
+                        "checkpoints (and the resume manifest) to this "
+                        "directory (PertConfig.checkpoint_dir)")
+    p.add_argument("--resume", default="auto",
+                   choices=["auto", "force", "off"],
+                   help="resume policy against --checkpoint-dir: 'auto' "
+                        "(default) restores completed steps and resumes "
+                        "in-flight fits mid-budget when the manifest's "
+                        "data fingerprint matches; 'force' skips the "
+                        "verification; 'off' starts fresh "
+                        "(PertConfig.resume)")
+    p.add_argument("--checkpoint-every", type=int, default=4,
+                   help="periodic in-fit checkpoint cadence in "
+                        "controller chunks (chunk = fit_diag_every "
+                        "iterations); 0 keeps only step-boundary "
+                        "checkpoints (PertConfig.checkpoint_every)")
+    p.add_argument("--faults", default=None,
+                   help="deterministic fault-injection spec for chaos "
+                        "testing, e.g. 'preempt@step2/chunk#2' "
+                        "(PertConfig.faults; see utils/faults.py)")
     from argparse import BooleanOptionalAction
     p.add_argument("--mirror-rescue", action=BooleanOptionalAction,
                    default=True,
@@ -101,6 +122,9 @@ def infer_scrt_main(argv=None):
                 cn_prior_method=args.cn_prior_method,
                 max_iter=args.max_iter, num_shards=args.num_shards,
                 clustering_method=args.clustering_method,
+                checkpoint_dir=args.checkpoint_dir, resume=args.resume,
+                checkpoint_every=args.checkpoint_every,
+                faults=args.faults,
                 mirror_rescue=args.mirror_rescue,
                 compile_cache_dir=args.compile_cache,
                 telemetry_path=args.telemetry,

@@ -99,6 +99,40 @@ class PertConfig:
     loci_shards: int = 1
     # write checkpoints at step boundaries (step1/step2/step3) to this dir.
     checkpoint_dir: Optional[str] = None
+    # --- durable runs (see OBSERVABILITY.md "Durable runs & resume") ---
+    # resume policy against an existing checkpoint_dir: 'auto' (default)
+    # restores completed steps and resumes in-flight fits ONLY when the
+    # manifest's data fingerprint matches this run's inputs (a config
+    # mismatch — e.g. a grown budget — is noted but allowed); 'force'
+    # restores regardless of the fingerprint; 'off' ignores existing
+    # checkpoints (and voids the prior step ledger) while still writing
+    # fresh ones.
+    resume: str = "auto"
+    # periodic in-fit checkpoint cadence, in controller chunks (chunk =
+    # fit_diag_every iterations): every N completed chunks the chunked
+    # fit driver persists params + Adam state + loss history + the
+    # controller ledger, so a killed run resumes MID-BUDGET bit-exactly
+    # instead of refitting the step.  Requires checkpoint_dir and an
+    # active controller; 0 disables the periodic cadence (step-boundary
+    # checkpoints and the graceful-abort emergency save remain).
+    checkpoint_every: int = 4
+    # deterministic fault-injection plan (utils/faults.py), e.g.
+    # 'preempt@step2/chunk#2,corrupt@step2/save'; None (default) leaves
+    # every injection site inert (one global check).  The PERT_FAULTS
+    # env var is the fallback when this is unset.  Chaos-testing only.
+    faults: Optional[str] = None
+    # bounded exponential backoff for TRANSIENT failures (tunnel drops,
+    # UNAVAILABLE): retries per step fit, and the base delay (doubled
+    # per retry, capped at 30s).  Non-transient errors never retry.
+    retry_max_attempts: int = 2
+    retry_backoff_seconds: float = 0.5
+    # per-phase watchdog deadlines (seconds; None disables): a compile
+    # or fit chunk exceeding its deadline raises a typed WatchdogTimeout
+    # that aborts WITH a resumable checkpoint — a diagnosable artifact
+    # instead of an external timeout's rc=124.  Leave None on healthy
+    # local backends; the TPU window runner sets them.
+    watchdog_compile_seconds: Optional[float] = None
+    watchdog_chunk_seconds: Optional[float] = None
     # enumerated-likelihood implementation: 'auto' picks the fused Pallas
     # kernel (ops/enum_kernel.py) on TPU (shard_map'd per device when a
     # mesh is active) and the XLA broadcast path elsewhere; 'xla' /

@@ -2,36 +2,91 @@
 
 The reference has no checkpoint/resume at all — learned state crosses the
 three SVI steps only in-memory (reference: pert_model.py:772-787, 836-851).
-The TPU runner persists, after each step, the fitted (unconstrained)
-parameter dict, the Adam optimiser state, the loss history and a small
-meta record (iterations run, converged flag) as a flat ``.npz``.
+The TPU runner persists, after each step (and periodically DURING a
+controller-chunked fit — see ``PertConfig.checkpoint_every``), the fitted
+(unconstrained) parameter dict, the Adam optimiser state, the loss history
+and a small meta record (iterations run, converged flag) as a flat
+``.npz``.
+
+Durability contract (this is restart-critical state, so every write is
+paranoid):
+
+* **atomic commit** — the npz is written to a temp file in the same
+  directory and ``os.replace``d into place, so a preemption mid-write
+  can never leave a torn file under the canonical name;
+* **integrity footer** — 48 trailing bytes (magic + payload length +
+  sha256 of the payload) appended after the zip payload (the zip EOCD
+  scan tolerates trailing data).  ``load_step`` verifies length and
+  digest before unpickling anything, so truncation/corruption surfaces
+  as a typed :class:`CheckpointCorrupt` naming the file instead of an
+  opaque zipfile/unpickling error;
+* **bounded retention** — each save rotates the previous good file to
+  ``pert_<step>.prev.npz`` first; a corrupt newest checkpoint falls
+  back to that predecessor (one extra fit segment re-run beats a dead
+  resume).
 
 Resume semantics (see ``runner.PertInference._fit``):
 
 * a COMPLETED step (converged, NaN-aborted, or out of budget) is restored
   as-is and not refit;
-* a PARTIAL step (stopped early, e.g. a smaller ``max_iter`` budget or a
-  killed run whose latest boundary file was partial) resumes optimisation
-  from the saved iteration with Adam moments intact — the resumed
-  trajectory is bit-identical to an uninterrupted run because the
-  compiled loop is deterministic given params + opt state + loss history.
+* a PARTIAL step (stopped early, killed mid-budget, or a periodic
+  in-fit checkpoint) resumes optimisation from the saved iteration with
+  Adam moments — and, for controller-chunked fits, the controller's
+  own state (best-loss checkpoint, budget ledger, diagnostics ring) —
+  intact, so the resumed trajectory is bit-identical to an
+  uninterrupted run (the compiled loop is deterministic given params +
+  opt state + loss history).
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
+import struct
 from typing import Optional
 
 import numpy as np
 
+from scdna_replication_tools_tpu.infer.manifest import atomic_write_bytes
+from scdna_replication_tools_tpu.utils.profiling import logger
+
 # Format history (the pi_logits layout contract lives in layout.py):
+#   v3  integrity footer appended; optional ctrl.* / best.* extras
+#       (controller resume state) — fully readable by the v2 loader
+#       layout-wise, so no layout bump
 #   v2  pi_logits stored STATE-MAJOR (P, cells, loci)
 #   v1  (never stamped) pi_logits cells-major — round <= 3 checkpoints;
 #       round-4 snapshots confusingly wrote state-major WITHOUT a stamp,
 #       so an unstamped 3-D pi_logits is AMBIGUOUS and load_step refuses
 #       it rather than guessing (a wrong guess trains on a transposed
 #       tensor); delete the stale .npz and refit.
-CHECKPOINT_FORMAT_VERSION = 2
+CHECKPOINT_FORMAT_VERSION = 3
+
+# integrity footer: magic(8) + little-endian payload length(8) + sha256(32)
+_FOOTER_MAGIC = b"PERTCK01"
+_FOOTER_LEN = len(_FOOTER_MAGIC) + 8 + 32
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint file failed integrity verification or parsing.
+
+    Carries the offending ``path`` so operators (and the RunLog event
+    the runner emits) can name the artifact to delete or investigate.
+    """
+
+    def __init__(self, path: str, reason: str):
+        super().__init__(f"corrupt checkpoint {path}: {reason}")
+        self.path = path
+        self.reason = reason
+
+
+def _step_path(checkpoint_dir: str, step: str) -> str:
+    return os.path.join(checkpoint_dir, f"pert_{step}.npz")
+
+
+def _prev_path(path: str) -> str:
+    root, ext = os.path.splitext(path)
+    return f"{root}.prev{ext}"
 
 
 def save_step(checkpoint_dir: str, step: str, params: dict,
@@ -39,10 +94,10 @@ def save_step(checkpoint_dir: str, step: str, params: dict,
               opt_state=None, num_iters: Optional[int] = None,
               converged: bool = True, nan_abort: bool = False) -> str:
     os.makedirs(checkpoint_dir, exist_ok=True)
-    path = os.path.join(checkpoint_dir, f"pert_{step}.npz")
+    path = _step_path(checkpoint_dir, step)
     flat = {f"param.{k}": np.asarray(v) for k, v in params.items()}
     flat["losses"] = np.asarray(losses)
-    # v2 = pi_logits stored state-major (P, cells, loci); see layout.py
+    # v3 = state-major pi_logits (see layout.py) + integrity footer
     flat["meta.format_version"] = np.asarray(CHECKPOINT_FORMAT_VERSION)
     flat["meta.num_iters"] = np.asarray(
         num_iters if num_iters is not None else len(losses))
@@ -57,20 +112,143 @@ def save_step(checkpoint_dir: str, step: str, params: dict,
             flat[f"opt.{i}"] = np.asarray(leaf)
     for k, v in (extra or {}).items():
         flat[f"extra.{k}"] = np.asarray(v)
-    np.savez(path, **flat)
+
+    # serialize to memory so the integrity footer hashes exactly the
+    # bytes that land on disk, then commit atomically with retention:
+    # rotate the previous good file aside BEFORE replacing it, so a
+    # corrupt new file (partial write + crash, or the injected
+    # corruption fault) always leaves a fallback
+    import io
+
+    buf = io.BytesIO()
+    np.savez(buf, **flat)
+    payload = buf.getvalue()
+    footer = (_FOOTER_MAGIC + struct.pack("<Q", len(payload))
+              + hashlib.sha256(payload).digest())
+    if os.path.exists(path):
+        try:
+            os.replace(path, _prev_path(path))
+        except OSError as exc:
+            logger.warning("checkpoint retention: could not rotate %s "
+                           "(%s)", path, exc)
+    atomic_write_bytes(path, payload + footer)
+
+    from scdna_replication_tools_tpu.utils import faults as _faults
+
+    if _faults.point(f"{step}/save") == "corrupt":
+        _faults.corrupt_file(path)
     return path
 
 
-def load_step(checkpoint_dir: str, step: str):
-    """Returns (params, losses, extra) or None if the checkpoint is absent.
+def quarantine_stale(checkpoint_dir: str) -> int:
+    """Rename every ``pert_*.npz`` (and retained ``.prev``) aside to
+    ``*.stale`` — called when the resume ledger is voided (fingerprint
+    mismatch under ``resume='auto'``, or ``resume='off'``).  Resetting
+    the ledger alone is not enough: the files would survive, and once
+    the NEW identity lands in the manifest a later run would
+    fingerprint-verify and silently restore params fitted to OTHER
+    data.  Renaming (not deleting) keeps the forensic artifact while
+    guaranteeing no loader ever reads it; returns the count moved."""
+    moved = 0
+    try:
+        import glob
 
-    ``extra`` carries the ``meta.*`` record and any ``opt.N`` optimiser
-    leaves (rebuild the pytree with :func:`restore_opt_state`).
+        for path in glob.glob(os.path.join(checkpoint_dir, "pert_*.npz")):
+            try:
+                os.replace(path, path + ".stale")
+                moved += 1
+            except OSError as exc:
+                logger.warning("could not quarantine stale checkpoint "
+                               "%s (%s)", path, exc)
+    except OSError as exc:
+        logger.warning("stale-checkpoint quarantine failed in %s (%s)",
+                       checkpoint_dir, exc)
+    if moved:
+        logger.warning("quarantined %d stale checkpoint file(s) in %s "
+                       "(renamed to *.stale)", moved, checkpoint_dir)
+    return moved
+
+
+def _verify_and_read(path: str):
+    """Verify the integrity footer and parse the npz; raises
+    :class:`CheckpointCorrupt` on any failure.  Pre-v3 files (no
+    footer) parse unverified — refusing every historical checkpoint
+    would turn an integrity upgrade into a fleet-wide refit."""
+    try:
+        with open(path, "rb") as fh:
+            blob = fh.read()
+    except OSError as exc:
+        raise CheckpointCorrupt(path, f"unreadable ({exc})")
+    if len(blob) >= _FOOTER_LEN \
+            and blob[-_FOOTER_LEN:-_FOOTER_LEN + len(_FOOTER_MAGIC)] \
+            == _FOOTER_MAGIC:
+        footer = blob[-_FOOTER_LEN:]
+        (length,) = struct.unpack(
+            "<Q", footer[len(_FOOTER_MAGIC):len(_FOOTER_MAGIC) + 8])
+        payload = blob[:-_FOOTER_LEN]
+        if len(payload) != length:
+            raise CheckpointCorrupt(
+                path, f"truncated: footer records {length} payload "
+                      f"bytes, file has {len(payload)}")
+        if hashlib.sha256(payload).digest() != footer[-32:]:
+            raise CheckpointCorrupt(path, "sha256 mismatch (bit rot or "
+                                          "partial overwrite)")
+    else:
+        payload = blob   # pre-v3: no footer to verify
+    import io
+
+    try:
+        return np.load(io.BytesIO(payload))
+    except Exception as exc:  # zipfile/ValueError/pickle zoo — the
+        # typed error IS this except block's purpose
+        raise CheckpointCorrupt(
+            path, f"unparseable npz ({type(exc).__name__}: {exc})")
+
+
+def load_step(checkpoint_dir: str, step: str):
+    """Returns (params, losses, extra), or None if no checkpoint exists.
+
+    ``extra`` carries the ``meta.*`` record, any ``opt.N`` optimiser
+    leaves (rebuild the pytree with :func:`restore_opt_state`) and any
+    ``ctrl.*``/``best.*`` controller resume state.  A corrupt newest
+    file falls back to the retained ``.prev`` checkpoint (with a
+    warning); when no fallback survives verification either, raises
+    :class:`CheckpointCorrupt` for the NEWEST file — the caller decides
+    whether a fresh refit is acceptable.
     """
-    path = os.path.join(checkpoint_dir, f"pert_{step}.npz")
+    path = _step_path(checkpoint_dir, step)
     if not os.path.exists(path):
+        prev = _prev_path(path)
+        if os.path.exists(prev):
+            # rotate-then-write crash window: the canonical file was
+            # rotated aside but the replacement never committed — the
+            # retained predecessor is the newest durable state
+            logger.warning(
+                "checkpoint %s is missing but its retained predecessor "
+                "exists (crash between rotation and commit?) — "
+                "restoring %s", path, prev)
+            data = _verify_and_read(prev)
+            return _unpack(prev, data)
         return None
-    data = np.load(path)
+    try:
+        data = _verify_and_read(path)
+    except CheckpointCorrupt as exc:
+        prev = _prev_path(path)
+        if os.path.exists(prev):
+            logger.warning(
+                "%s — falling back to the retained previous checkpoint "
+                "%s", exc, prev)
+            try:
+                data = _verify_and_read(prev)
+            except CheckpointCorrupt:
+                raise exc from None   # report the NEWEST file
+        else:
+            raise
+    return _unpack(path, data)
+
+
+def _unpack(path: str, data):
+    """(params, losses, extra) from a verified npz archive."""
     params = {k[len("param."):]: data[k] for k in data.files
               if k.startswith("param.")}
     extra = {k[len("extra."):]: data[k] for k in data.files
@@ -104,3 +282,60 @@ def restore_opt_state(extra: dict, params: dict, learning_rate: float,
     treedef = jax.tree_util.tree_structure(template)
     leaves = [extra[k] for k in opt_keys]
     return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def restore_controller_state(extra: dict) -> Optional[dict]:
+    """Rebuild the chunked-fit controller's resume state from a
+    checkpoint's ``ctrl.*`` / ``best.*`` extras, or None when the
+    checkpoint predates in-fit checkpointing (``infer/svi.py``'s
+    ``resume_state`` contract — the fields that make a mid-fit resume
+    reproduce the uninterrupted decision trail bit-exactly)."""
+    if "ctrl.format" not in extra:
+        return None
+    state = {
+        "reseeds": int(extra["ctrl.reseeds"]),
+        "extra_granted": int(extra["ctrl.extra_granted"]),
+        "nan_retries": int(extra["ctrl.nan_retries"]),
+        "lr": float(extra["ctrl.lr"]),
+        "budget": int(extra["ctrl.budget"]),
+        "stagnation_anchor": int(extra["ctrl.stagnation_anchor"]),
+        "prev_verdict": str(extra["ctrl.prev_verdict"]) or None,
+        "best_loss": float(extra["ctrl.best_loss"]),
+        "best_it": int(extra["ctrl.best_it"]),
+        "diag": np.asarray(extra["ctrl.diag"]),
+        "diag_i0": int(extra["ctrl.diag_i0"]),
+    }
+    best = {k[len("best."):]: np.asarray(v) for k, v in extra.items()
+            if k.startswith("best.")}
+    if best:
+        state["best_params"] = best
+    else:
+        # an inexact (mid-chunk emergency) save may have lost the
+        # best-loss params; a finite best_loss without its params would
+        # make the early-stop restore hand back the WRONG state — drop
+        # the record and let the resumed segment re-establish its best
+        state["best_loss"] = float("inf")
+        state["best_it"] = 0
+    return state
+
+
+def pack_controller_state(state: dict) -> dict:
+    """Flatten an ``infer/svi.py`` controller state dict into the
+    ``extra`` keys :func:`restore_controller_state` reads back."""
+    out = {
+        "ctrl.format": 1,
+        "ctrl.reseeds": int(state["reseeds"]),
+        "ctrl.extra_granted": int(state["extra_granted"]),
+        "ctrl.nan_retries": int(state["nan_retries"]),
+        "ctrl.lr": float(state["lr"]),
+        "ctrl.budget": int(state["budget"]),
+        "ctrl.stagnation_anchor": int(state["stagnation_anchor"]),
+        "ctrl.prev_verdict": state.get("prev_verdict") or "",
+        "ctrl.best_loss": float(state["best_loss"]),
+        "ctrl.best_it": int(state["best_it"]),
+        "ctrl.diag": np.asarray(state["diag"]),
+        "ctrl.diag_i0": int(state["diag_i0"]),
+    }
+    for k, v in (state.get("best_params") or {}).items():
+        out[f"best.{k}"] = np.asarray(v)
+    return out

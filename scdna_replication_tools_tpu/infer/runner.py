@@ -21,6 +21,7 @@ boundary is checkpointed (the reference keeps all state in memory only).
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Optional, Tuple
 
@@ -37,7 +38,9 @@ from scdna_replication_tools_tpu.data.loader import (
     pad_loci,
 )
 from scdna_replication_tools_tpu.infer import checkpoint as ckpt
+from scdna_replication_tools_tpu.infer import manifest as manifest_mod
 from scdna_replication_tools_tpu.infer.svi import FitResult, fit_map
+from scdna_replication_tools_tpu.utils import faults as faults_mod
 from scdna_replication_tools_tpu.models import priors
 from scdna_replication_tools_tpu.models.pert import (
     PertBatch,
@@ -150,6 +153,12 @@ class PertInference:
         num_clones: int = 0,
         run_log: Optional[RunLog] = None,
     ):
+        if config.resume not in ("auto", "force", "off"):
+            # validate BEFORE any manifest mutation below: a typo'd
+            # resume value must not cost durable resume state
+            raise ValueError(
+                f"resume must be 'auto', 'force' or 'off', got "
+                f"{config.resume!r}")
         self.s = s_data
         self.g1 = g1_data
         self.config = config
@@ -176,6 +185,62 @@ class PertInference:
         # or disabled): repeated runs skip the per-step-program compiles
         self.compile_cache_dir = profiling.enable_persistent_compile_cache(
             config.compile_cache_dir)
+        # fault-injection plan (utils/faults.py): config/env-gated,
+        # deterministic, inert (a single global None check per site)
+        # unless a spec is present.  Installed unconditionally — the
+        # newest runner's config wins, so a resume run with faults=None
+        # cannot inherit a previous run's plan in the same process
+        faults_mod.install(faults_mod.resolve_plan(config.faults))
+        # durable run manifest (infer/manifest.py): the resume ledger of
+        # the checkpoint directory — identity (config hash + data
+        # fingerprint) decides whether existing checkpoints belong to
+        # THIS workload, per-step statuses record how far prior attempts
+        # got.  resume='auto' restores only fingerprint-verified state;
+        # a mismatch under 'auto' voids the stale step ledger.
+        self._manifest = None
+        self._resume_ok = False
+        self._resume_reason = "checkpointing disabled"
+        # steps THIS process has checkpointed: a transient retry may
+        # always resume what this very run wrote, even when the
+        # directory's prior identity could not be verified (fresh dir,
+        # or a mismatch that reset the ledger) — the files carry the
+        # current identity by construction
+        self._steps_written: set = set()
+        if config.checkpoint_dir:
+            from scdna_replication_tools_tpu.obs.runlog import \
+                _config_digest
+
+            # everything the fit consumes, not just reads: changed CN
+            # states, clone assignments or the RT prior also invalidate
+            # old checkpoints (the priors/conditioning they shaped)
+            fingerprint = manifest_mod.data_fingerprint(
+                s_data.reads, g1_data.reads, s_data.states,
+                g1_data.states, clone_idx_s, clone_idx_g1,
+                s_data.rt_prior)
+            cfg_hash = _config_digest(config)
+            m = manifest_mod.RunManifest.load(config.checkpoint_dir)
+            self._resume_ok, self._resume_reason = m.match(cfg_hash,
+                                                           fingerprint)
+            had_identity = m.doc.get("data_fingerprint") is not None
+            reset = (config.resume == "off"
+                     or (had_identity and not self._resume_ok
+                         and config.resume != "force"))
+            if reset:
+                # voiding the ledger must also retire the FILES: once
+                # this run's identity lands in the manifest, surviving
+                # stale checkpoints would fingerprint-verify for the
+                # next run and restore params fitted to other data
+                ckpt.quarantine_stale(config.checkpoint_dir)
+            m.begin_run(cfg_hash, fingerprint,
+                        run_log_path=self.run_log.path,
+                        reset_steps=reset)
+            self._manifest = m
+            if had_identity and not self._resume_ok \
+                    and config.resume == "auto":
+                profiling.logger.warning(
+                    "checkpoint dir %s: %s — starting fresh (use "
+                    "resume='force' to override)", config.checkpoint_dir,
+                    self._resume_reason)
         if config.rho_from_rt_prior and s_data.rt_prior is None:
             # fail fast: surfacing this inside run_step2 would waste the
             # whole step-1 fit first
@@ -363,42 +428,160 @@ class PertInference:
 
     def _fit(self, spec, batch, fixed, t_init, max_iter, min_iter,
              step_name) -> StepOutput:
+        """One step fit under the recovery ladder (utils/faults.py):
+
+        * **transient** failures (tunnel drops, UNAVAILABLE) retry with
+          bounded exponential backoff — and because the chunked driver
+          saved an in-flight checkpoint on the way out, each retry
+          RESUMES the fit rather than restarting it;
+        * **oom** / **hang** abort with the resumable artifact that
+          same save left behind (plus a ``degrade`` audit event) — the
+          next ``--resume auto`` run continues mid-budget;
+        * **preemption** (BaseException) propagates untouched after the
+          graceful save: the process is going away;
+        * **deterministic** errors propagate immediately — retrying a
+          real bug only hides it.
+        """
+        cfg = self.config
+
+        def attempt():
+            try:
+                return self._fit_once(spec, batch, fixed, t_init,
+                                      max_iter, min_iter, step_name)
+            except Exception as exc:
+                kind = faults_mod.classify_exception(exc)
+                if kind in ("oom", "hang"):
+                    self.run_log.emit(
+                        "degrade", step=step_name,
+                        action=("watchdog_abort" if kind == "hang"
+                                else "abort_resumable"),
+                        error_class=kind,
+                        error=f"{type(exc).__name__}: {str(exc)[:300]}",
+                        detail=("fit aborted on a non-retryable "
+                                f"{kind}; the in-flight checkpoint "
+                                "(when checkpointing is enabled) makes "
+                                "the next --resume auto run continue "
+                                "mid-budget"))
+                raise
+
+        # transient classification, deterministic backoff and the
+        # `retry` audit event all live in ONE place (utils/faults.py);
+        # each retry re-enters _fit_once, whose _load_resumable picks
+        # up the in-flight checkpoint — retries RESUME, not restart
+        return faults_mod.retry_call(
+            attempt, label=f"{step_name}/fit",
+            max_attempts=int(cfg.retry_max_attempts),
+            base_delay=float(cfg.retry_backoff_seconds))
+
+    def _load_resumable(self, step_name, max_iter, spec, fixed, batch):
+        """Resume-mode + manifest-aware checkpoint restore for one step.
+
+        Returns a completed :class:`StepOutput` (restore, no refit), a
+        ``(params0, opt_state0, losses_prefix, resume_ctrl)`` tuple for
+        a partial fit, or None for a fresh fit.  Every outcome that
+        touched a checkpoint emits a ``resume`` event so the decision
+        is reproducible from the artifact alone.
+        """
+        cfg = self.config
+        if cfg.resume == "off" and step_name not in self._steps_written:
+            # 'off' ignores PRE-EXISTING state; a transient retry still
+            # resumes the checkpoints this very run wrote
+            return None
+        if cfg.resume == "auto" and not self._resume_ok \
+                and step_name not in self._steps_written:
+            # only audit a refusal when there was something to refuse
+            if os.path.exists(os.path.join(
+                    cfg.checkpoint_dir, f"pert_{step_name}.npz")):
+                self.run_log.emit(
+                    "resume", step=step_name, mode=cfg.resume,
+                    action="fresh", fingerprint_verified=False,
+                    reason=self._resume_reason)
+            return None
+        try:
+            restored = ckpt.load_step(cfg.checkpoint_dir, step_name)
+        except ckpt.CheckpointCorrupt as exc:
+            # graceful degradation: a corrupt artifact (and no valid
+            # retained predecessor) costs a refit, never the run
+            self.run_log.emit("degrade", step=step_name,
+                              action="checkpoint_discarded",
+                              error_class="corrupt",
+                              detail=str(exc)[:500])
+            profiling.logger.warning("%s — refitting %s from scratch",
+                                     exc, step_name)
+            return None
+        if restored is None:
+            return None
+        params, losses, extra = restored
+        params = {k: jnp.asarray(v) for k, v in params.items()}
+        num_iters = int(extra.get("meta.num_iters", len(losses)))
+        converged = bool(extra.get("meta.converged", True))
+        nan_abort = bool(extra.get("meta.nan_abort", False))
+        resume_ctrl = ckpt.restore_controller_state(extra)
+        # a controller-extended budget survives in the resume state (a
+        # fit killed past max_iter but inside its extended budget is
+        # still PARTIAL) — but a GROWN config budget wins: resuming
+        # with a larger max_iter is the documented budget-growth
+        # workflow, and the saved (smaller) budget must not mark the
+        # step complete before the new budget ran
+        budget = int(max_iter)
+        if resume_ctrl:
+            budget = max(int(resume_ctrl["budget"]), budget)
+            resume_ctrl["budget"] = budget
+        completed = bool(converged or nan_abort or num_iters >= budget)
+        self.run_log.emit(
+            "checkpoint", action="load", step=step_name,
+            path=str(cfg.checkpoint_dir), num_iters=num_iters,
+            completed=completed)
+        own_write = step_name in self._steps_written
+        self.run_log.emit(
+            "resume", step=step_name, mode=cfg.resume,
+            action="restored" if completed else "resumed",
+            from_iter=num_iters,
+            fingerprint_verified=bool(self._resume_ok or own_write),
+            reason=("checkpoint written by this run (retry resume)"
+                    if own_write and not self._resume_ok
+                    else self._resume_reason))
+        if completed:
+            # completed step: restore as-is, no refit.  budget must be
+            # a real integer — the rescue gate's control_decision event
+            # types it as such in the schema, restored fits included
+            fit = FitResult(params=params, losses=losses,
+                            num_iters=num_iters, converged=converged,
+                            nan_abort=nan_abort,
+                            budget=max(budget, num_iters))
+            if self._manifest is not None:
+                self._manifest.update_step(step_name, "complete",
+                                           num_iters=num_iters)
+            return StepOutput(fit, spec, fixed, batch, 0.0)
+        # partial step: resume from the saved iteration with Adam
+        # moments (and, for chunked fits, the controller ledger) intact
+        # — exact continuation of the trajectory
+        opt_state0 = ckpt.restore_opt_state(
+            extra, params, cfg.learning_rate, cfg.adam_b1, cfg.adam_b2)
+        losses_prefix = np.asarray(losses)[:num_iters]
+        return params, opt_state0, losses_prefix, resume_ctrl
+
+    def _fit_once(self, spec, batch, fixed, t_init, max_iter, min_iter,
+                  step_name) -> StepOutput:
         cfg = self.config
         params0 = opt_state0 = losses_prefix = None
+        resume_ctrl = None
         if cfg.checkpoint_dir:
-            restored = ckpt.load_step(cfg.checkpoint_dir, step_name)
-            if restored is not None:
-                params, losses, extra = restored
-                params = {k: jnp.asarray(v) for k, v in params.items()}
-                num_iters = int(extra.get("meta.num_iters", len(losses)))
-                converged = bool(extra.get("meta.converged", True))
-                nan_abort = bool(extra.get("meta.nan_abort", False))
-                self.run_log.emit(
-                    "checkpoint", action="load", step=step_name,
-                    path=str(cfg.checkpoint_dir), num_iters=num_iters,
-                    completed=bool(converged or nan_abort
-                                   or num_iters >= max_iter))
-                if converged or nan_abort or num_iters >= max_iter:
-                    # completed step: restore as-is, no refit.  budget
-                    # must be a real integer — the rescue gate's
-                    # control_decision event types it as such in the
-                    # schema, restored fits included.  The checkpoint
-                    # does not persist a controller-extended budget, so
-                    # a fit that ran past max_iter restores with its
-                    # own iteration count as the floor (iter > budget
-                    # would contradict the audit trail)
-                    fit = FitResult(params=params, losses=losses,
-                                    num_iters=num_iters, converged=converged,
-                                    nan_abort=nan_abort,
-                                    budget=max(int(max_iter), num_iters))
-                    return StepOutput(fit, spec, fixed, batch, 0.0)
-                # partial step: resume from the saved iteration with Adam
-                # moments intact (exact continuation of the trajectory)
-                params0 = params
-                opt_state0 = ckpt.restore_opt_state(
-                    extra, params, cfg.learning_rate, cfg.adam_b1,
-                    cfg.adam_b2)
-                losses_prefix = np.asarray(losses)[:num_iters]
+            loaded = self._load_resumable(step_name, max_iter, spec,
+                                          fixed, batch)
+            if isinstance(loaded, StepOutput):
+                return loaded
+            if loaded is not None:
+                params0, opt_state0, losses_prefix, resume_ctrl = loaded
+
+        # phase-boundary injection site: a preemption here models the
+        # classic kill-between-steps window
+        faults_mod.point(f"{step_name}/start")
+        if self._manifest is not None:
+            self._manifest.update_step(
+                step_name, "in_flight",
+                num_iters=len(losses_prefix)
+                if losses_prefix is not None else 0)
 
         if params0 is None:
             with self.phases.phase(f"{step_name}/init"):
@@ -420,6 +603,39 @@ class PertInference:
         if self._controller_active(min_iter, max_iter):
             controller = ControllerPolicy.from_config(cfg, max_iter)
 
+        checkpoint_cb = None
+        if cfg.checkpoint_dir:
+            # the durability sink of the chunked driver: periodic
+            # in-fit saves (every checkpoint_every chunks) and the
+            # emergency save on an escaping exception both land here
+            def checkpoint_cb(*, params, opt_state, losses, num_iters,
+                              state=None, exact=True):
+                extra = ckpt.pack_controller_state(state) if state \
+                    else None
+                path = ckpt.save_step(
+                    cfg.checkpoint_dir, step_name, params, losses,
+                    opt_state=opt_state, num_iters=int(num_iters),
+                    converged=False, nan_abort=False, extra=extra)
+                self._steps_written.add(step_name)
+                self.run_log.emit(
+                    "checkpoint", action="save", step=step_name,
+                    path=str(cfg.checkpoint_dir),
+                    num_iters=int(num_iters), completed=False)
+                if not exact:
+                    self.run_log.emit(
+                        "degrade", step=step_name,
+                        action="inexact_checkpoint",
+                        detail=(f"optimizer state was unavailable at "
+                                f"the emergency save (mid-chunk "
+                                f"abort); a resume restarts the Adam "
+                                f"moments at iteration {num_iters} — "
+                                f"the documented rescue tolerance"))
+                if self._manifest is not None:
+                    self._manifest.update_step(
+                        step_name, "in_flight",
+                        num_iters=int(num_iters), checkpoint=path,
+                        exact=bool(exact))
+
         t0 = time.perf_counter()
         with profiling.trace(cfg.profile_dir):
             fit = fit_map(loss_fn, params0, (fixed, batch),
@@ -437,7 +653,12 @@ class PertInference:
                               grad_ratio=cfg.doctor_grad_ratio),
                           controller=controller,
                           escalate_dir=cfg.checkpoint_dir,
-                          escalate_tag=step_name)
+                          escalate_tag=step_name,
+                          checkpoint_every=cfg.checkpoint_every,
+                          checkpoint_cb=checkpoint_cb,
+                          resume_state=resume_ctrl,
+                          compile_deadline=cfg.watchdog_compile_seconds,
+                          chunk_deadline=cfg.watchdog_chunk_seconds)
         wall = time.perf_counter() - t0
         for key in ("trace", "compile", "fit"):
             self.phases.add(f"{step_name}/{key}", fit.timings.get(key, 0.0))
@@ -450,6 +671,10 @@ class PertInference:
                                            else 0))
 
         if cfg.checkpoint_dir:
+            completed = bool(fit.converged or fit.nan_abort
+                             or fit.num_iters >= (fit.budget
+                                                  if fit.budget is not None
+                                                  else max_iter))
             with self.phases.phase(f"{step_name}/checkpoint"):
                 ckpt.save_step(cfg.checkpoint_dir, step_name,
                                jax.tree_util.tree_map(np.asarray, fit.params),
@@ -459,11 +684,19 @@ class PertInference:
                                num_iters=fit.num_iters,
                                converged=fit.converged,
                                nan_abort=fit.nan_abort)
+            self._steps_written.add(step_name)
             self.run_log.emit("checkpoint", action="save", step=step_name,
                               path=str(cfg.checkpoint_dir),
                               num_iters=fit.num_iters,
-                              completed=bool(fit.converged or fit.nan_abort
-                                             or fit.num_iters >= max_iter))
+                              completed=completed)
+            if self._manifest is not None:
+                self._manifest.update_step(
+                    step_name,
+                    "complete" if completed else "in_flight",
+                    num_iters=fit.num_iters)
+        # phase-boundary injection site: the step's outputs are durably
+        # committed — a preemption here must resume at the NEXT step
+        faults_mod.point(f"{step_name}/end")
         return StepOutput(fit, spec, fixed, batch, wall)
 
     @staticmethod
@@ -976,6 +1209,7 @@ class PertInference:
             else data.num_cells
         cell_ids = list(data.cell_ids)[:n]
 
+        ppc_dropped = False
         with timer.phase("qc/ppc"):
             key = jax.random.PRNGKey(cfg.seed)
             # the MAP planes the packaging decode already produced ride
@@ -984,11 +1218,31 @@ class PertInference:
             # work); the h2d of two int planes is noise next to that
             maps = (qc_stats["cn_map"], qc_stats["rep_map"]) \
                 if "cn_map" in qc_stats else None
-            ppc_dev, ppc_z = jax.device_get(ppc_discrepancy(
-                spec, params, fixed, batch, key,
-                num_replicates=cfg.qc_ppc_replicates, maps=maps))
-            ppc_dev = np.asarray(ppc_dev)[:n]
-            ppc_z = np.asarray(ppc_z)[:n]
+            try:
+                faults_mod.point("qc/ppc")
+                ppc_dev, ppc_z = jax.device_get(ppc_discrepancy(
+                    spec, params, fixed, batch, key,
+                    num_replicates=cfg.qc_ppc_replicates, maps=maps))
+                ppc_dev = np.asarray(ppc_dev)[:n]
+                ppc_z = np.asarray(ppc_z)[:n]
+            except Exception as exc:
+                if faults_mod.classify_exception(exc) != "oom":
+                    raise
+                # degradation ladder, QC rung: the PPC is an optional
+                # health surface — drop it rather than kill a run whose
+                # inference results are already computed and durable
+                ppc_dropped = True
+                ppc_dev = np.full(n, np.nan, np.float64)
+                ppc_z = np.full(n, np.nan, np.float64)
+                self.run_log.emit(
+                    "degrade", step=step_name, action="drop_ppc",
+                    error_class="oom",
+                    detail=("posterior-predictive check OOMed — PPC "
+                            "columns are NaN and the ppc_outlier flag "
+                            "is disabled for this run"),
+                    error=f"{type(exc).__name__}: {str(exc)[:300]}")
+                profiling.logger.warning(
+                    "cell QC: PPC dropped after OOM (%s)", exc)
 
         with timer.phase("qc/package"):
             tau = np.asarray(qc_stats["tau"])[:n]
@@ -1005,8 +1259,12 @@ class PertInference:
                 rescue_cand[c[c < n]] = True
                 rescue_acc[a[a < n]] = True
 
-            finite = (np.isfinite(tau) & np.isfinite(mean_ent)
-                      & np.isfinite(ppc_z))
+            finite = np.isfinite(tau) & np.isfinite(mean_ent)
+            if not ppc_dropped:
+                # a degraded (dropped) PPC leaves NaN columns that must
+                # not flag every cell non_finite — the drop is audited,
+                # not punished
+                finite &= np.isfinite(ppc_z)
             # NaN comparisons are False, so a poisoned cell lands only in
             # non_finite — the one flag that subsumes the others
             flag_arrays = {
@@ -1066,7 +1324,8 @@ class PertInference:
                     range=(0.0, 1.0))[0]],
                 mean_cn_entropy_mean=self._finite(np.nanmean(mean_ent))
                 if n else None,
-                ppc_z_max=self._finite(np.nanmax(ppc_z)) if n else None,
+                ppc_z_max=self._finite(np.nanmax(ppc_z))
+                if n and np.isfinite(ppc_z).any() else None,
                 flagged_cells=[{
                     "cell_id": str(cell_ids[i]),
                     "reasons": flags[i].split(","),
@@ -1109,6 +1368,97 @@ class PertInference:
 # ---------------------------------------------------------------------------
 # output packaging (pandas parity)
 # ---------------------------------------------------------------------------
+
+def _decode_with_degradation(spec, params, fixed, batch, data,
+                             hmm_self_prob, want_entropy: bool,
+                             phase_prefix: str):
+    """The packaging decode under the OOM degradation ladder.
+
+    Returns ``(decoded, ent_planes, want_entropy)``.  On a classified
+    RESOURCE_EXHAUSTED the ladder walks: halve the decode slab (three
+    times — each halving halves the live joint tensor), then drop the
+    optional QC entropy surfaces (two fewer output planes per slab and
+    no QC pass downstream), then re-raise — at which point every step's
+    results are already in durable checkpoints, so the abort is
+    resumable.  Every rung is audited as a ``degrade`` RunLog event.
+    Deterministic errors propagate from the first attempt untouched.
+    """
+    from scdna_replication_tools_tpu.models import pert as pert_mod
+    from scdna_replication_tools_tpu.obs import runlog as _runlog
+
+    num_loci = batch.reads.shape[1]
+    auto_chunk = max(1, pert_mod._DECODE_SLAB_BYTES
+                     // max(num_loci * spec.P * 2 * 4, 1))
+
+    def _decode(chunk, entropy):
+        faults_mod.point(f"{phase_prefix}/decode")
+        if hmm_self_prob is not None:
+            from scdna_replication_tools_tpu.models.pert import (
+                decode_discrete_hmm,
+            )
+            chroms = data.loci.get_level_values(0)
+            restart = jnp.asarray(
+                np.r_[1.0, (chroms[1:] != chroms[:-1]).astype(np.float32)])
+            out = decode_discrete_hmm(
+                spec, params, fixed, batch, restart, hmm_self_prob,
+                want_entropy=entropy)
+        else:
+            out = decode_discrete(spec, params, fixed, batch,
+                                  want_entropy=entropy,
+                                  cell_chunk=chunk)
+        if entropy:
+            return out[:3], out[3:]
+        return out, None
+
+    # rung 0 is the normal path (auto slab); rungs 1-3 halve it.  The
+    # HMM decode has no slab knob (its Viterbi pass is whole-genome per
+    # cell), so its ladder goes straight from the normal attempt to
+    # dropping the QC surfaces — re-running an identical decode three
+    # times would only triple the OOM wait
+    if hmm_self_prob is not None:
+        ladder = [None]
+    else:
+        ladder = [None] + [max(1, auto_chunk >> k) for k in (1, 2, 3)]
+    last_exc = None
+    for rung, chunk in enumerate(ladder):
+        try:
+            decoded, ent_planes = _decode(chunk, want_entropy)
+            return decoded, ent_planes, want_entropy
+        except Exception as exc:
+            if faults_mod.classify_exception(exc) != "oom":
+                raise
+            last_exc = exc
+            if rung == len(ladder) - 1:
+                break
+            _runlog.current().emit(
+                "degrade", step=phase_prefix, action="halve_decode_slab",
+                detail=(f"decode OOM at slab={chunk or auto_chunk} "
+                        f"cells — retrying at {max(1, auto_chunk >> (rung + 1))}"),
+                error=f"{type(exc).__name__}: {str(exc)[:300]}")
+    if want_entropy:
+        # next rung: drop the optional QC surfaces and retry once at
+        # the smallest slab
+        _runlog.current().emit(
+            "degrade", step=phase_prefix, action="drop_qc_surfaces",
+            detail=("decode still OOM at the smallest slab — dropping "
+                    "the posterior-entropy planes (model_cn_entropy "
+                    "column and the per-cell QC table) for this run"),
+            error=f"{type(last_exc).__name__}: {str(last_exc)[:300]}")
+        try:
+            decoded, ent_planes = _decode(ladder[-1], False)
+            return decoded, ent_planes, False
+        except Exception as exc:
+            if faults_mod.classify_exception(exc) != "oom":
+                raise
+            last_exc = exc
+    _runlog.current().emit(
+        "degrade", step=phase_prefix, action="abort_resumable",
+        error_class="oom",
+        detail=("decode OOM after the full degradation ladder; step "
+                "checkpoints are durable, so the run is resumable"),
+        error=f"{type(last_exc).__name__}: {str(last_exc)[:300]}")
+    raise last_exc
+
 
 def package_step_output(
     cn_long: pd.DataFrame,
@@ -1154,25 +1504,16 @@ def package_step_output(
     spec, params, fixed, batch = step.spec, step.fit.params, step.fixed, step.batch
     timer = timer or profiling.PhaseTimer()
     want_entropy = qc_collect is not None
-    ent_planes = None
     with timer.phase(f"{phase_prefix}/decode"):
-        if hmm_self_prob is not None:
-            from scdna_replication_tools_tpu.models.pert import (
-                decode_discrete_hmm,
-            )
-            chroms = data.loci.get_level_values(0)
-            restart = jnp.asarray(
-                np.r_[1.0, (chroms[1:] != chroms[:-1]).astype(np.float32)])
-            decoded = decode_discrete_hmm(
-                spec, params, fixed, batch, restart, hmm_self_prob,
-                want_entropy=want_entropy)
-            if want_entropy:
-                decoded, ent_planes = decoded[:3], decoded[3:]
-        else:
-            decoded = decode_discrete(spec, params, fixed, batch,
-                                      want_entropy=want_entropy)
-            if want_entropy:
-                decoded, ent_planes = decoded[:3], decoded[3:]
+        decoded, ent_planes, want_entropy = _decode_with_degradation(
+            spec, params, fixed, batch, data, hmm_self_prob,
+            want_entropy, phase_prefix)
+        if qc_collect is not None and not want_entropy:
+            # the degradation ladder dropped the optional QC surfaces;
+            # tell the caller so it skips the QC table instead of
+            # KeyError-ing on the missing aggregates
+            qc_collect["degraded"] = True
+            qc_collect = None
         c = constrained(spec, params, fixed)
 
     n = int(np.sum(data.cell_mask)) if data.cell_mask is not None \

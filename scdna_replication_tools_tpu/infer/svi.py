@@ -34,6 +34,7 @@ import optax
 
 from scdna_replication_tools_tpu.obs import doctor as _doctor
 from scdna_replication_tools_tpu.obs import runlog as _runlog
+from scdna_replication_tools_tpu.utils import faults as _faults
 
 # fixed slot count of the in-fit diagnostics ring buffer: large enough
 # that a converged fit's whole sampled trajectory usually survives, small
@@ -264,7 +265,7 @@ def _key_hash(key) -> str:
 
 def _resolve_program(target, tag: str, loss_fn, dynamic_args,
                      dynamic_kwargs: dict, static_kwargs: dict,
-                     timings: dict):
+                     timings: dict, compile_deadline=None):
     """Compiled program of ``target`` for this signature, timed on miss.
 
     Shared by the whole-budget program (``_run_fit``) and the
@@ -303,7 +304,18 @@ def _resolve_program(target, tag: str, loss_fn, dynamic_args,
     lowered = target.lower(loss_fn, *dynamic_args, **dynamic_kwargs,
                            **static_kwargs)
     t1 = time.perf_counter()
-    compiled = lowered.compile()
+
+    # per-phase watchdog: an XLA compile over a dead TPU tunnel blocks
+    # forever with ~0 CPU (the BENCH_r05 rc=124 failure mode); the
+    # deadline converts that into a typed, checkpointable abort.  The
+    # fault-injection site sits INSIDE the deadline so a simulated
+    # `hang@compile` exercises the real watchdog path.
+    def _do_compile():
+        _faults.point("compile")
+        return lowered.compile()
+
+    compiled = _faults.run_with_deadline(
+        _do_compile, compile_deadline, f"compile:{tag}")
     t2 = time.perf_counter()
     timings["trace"] = t1 - t0
     timings["compile"] = t2 - t1
@@ -327,6 +339,10 @@ def fit_map(loss_fn: Callable, params0: dict, loss_args: tuple = (),
             doctor_thresholds: Optional[dict] = None,
             controller=None, escalate_dir: Optional[str] = None,
             escalate_tag: str = "fit",
+            checkpoint_every: int = 0, checkpoint_cb=None,
+            resume_state: Optional[dict] = None,
+            compile_deadline: Optional[float] = None,
+            chunk_deadline: Optional[float] = None,
             ) -> FitResult:
     """Fit ``params`` by MAP ascent of ``-loss_fn`` with reference semantics.
 
@@ -378,6 +394,17 @@ def fit_map(loss_fn: Callable, params0: dict, loss_args: tuple = (),
     aborting.  The audit trail lands on ``FitResult.decisions``.
     ``controller=None`` (the default) keeps the original single-program
     path bit-exactly.
+
+    Durability (chunked path only — see OBSERVABILITY.md "Durable
+    runs"): ``checkpoint_every=N`` calls ``checkpoint_cb`` every N
+    completed chunks (and, best-effort, on any exception escaping the
+    loop) with the host state needed for an exact mid-fit resume —
+    params, opt state, loss prefix and the controller's own ledger;
+    ``resume_state`` (from ``checkpoint.restore_controller_state``)
+    restores that ledger so a resumed fit reproduces the uninterrupted
+    decision trail bit-exactly.  ``compile_deadline``/``chunk_deadline``
+    arm the per-phase watchdog (``utils.faults.run_with_deadline``),
+    turning hangs into typed, checkpointed aborts.
     """
     if controller is not None and diag_every:
         return _fit_map_controlled(
@@ -387,7 +414,10 @@ def fit_map(loss_fn: Callable, params0: dict, loss_args: tuple = (),
             opt_state0=opt_state0, losses_prefix=losses_prefix,
             diag_every=diag_every, doctor_thresholds=doctor_thresholds,
             policy=controller, escalate_dir=escalate_dir,
-            escalate_tag=escalate_tag)
+            escalate_tag=escalate_tag,
+            checkpoint_every=checkpoint_every, checkpoint_cb=checkpoint_cb,
+            resume_state=resume_state, compile_deadline=compile_deadline,
+            chunk_deadline=chunk_deadline)
     if opt_state0 is None:
         params0 = jax.tree_util.tree_map(jnp.asarray, params0)
         opt_state0 = make_opt_state(params0, learning_rate, b1, b2)
@@ -424,7 +454,7 @@ def fit_map(loss_fn: Callable, params0: dict, loss_args: tuple = (),
     timings: dict = {"trace": 0.0, "compile": 0.0}
     compiled = _resolve_program(_run_fit, "fit", loss_fn, dynamic_args,
                                 {"rel_tol": rel_tol}, static_kwargs,
-                                timings)
+                                timings, compile_deadline=compile_deadline)
 
     t0 = time.perf_counter()
     if compiled is not None:
@@ -483,7 +513,12 @@ def _fit_map_controlled(loss_fn: Callable, params0: dict, loss_args: tuple,
                         opt_state0, losses_prefix, diag_every: int,
                         doctor_thresholds: Optional[dict], policy,
                         escalate_dir: Optional[str],
-                        escalate_tag: str) -> FitResult:
+                        escalate_tag: str,
+                        checkpoint_every: int = 0, checkpoint_cb=None,
+                        resume_state: Optional[dict] = None,
+                        compile_deadline: Optional[float] = None,
+                        chunk_deadline: Optional[float] = None
+                        ) -> FitResult:
     """Adaptive (chunked) twin of :func:`fit_map` — see its docstring.
 
     The outer loop runs on the host; each chunk is one dispatch of the
@@ -493,13 +528,27 @@ def _fit_map_controlled(loss_fn: Callable, params0: dict, loss_args: tuple,
     trajectory + the diagnostics ring-buffer tail and issues decisions;
     this function applies them to the device state and records the
     audit trail on ``FitResult.decisions``.
+
+    The chunk boundaries are also the durability points: the
+    fault-injection site ``{escalate_tag}/chunk`` fires at the top of
+    every iteration of the host loop (where params, opt state and the
+    loss buffer are all live chunk OUTPUTS — a graceful save there is
+    exact), ``checkpoint_cb`` runs every ``checkpoint_every`` completed
+    chunks and best-effort on the way out of any escaping exception,
+    and ``chunk_deadline`` bounds each dispatch+fetch.
     """
     from scdna_replication_tools_tpu.obs import controller as _controller
 
     max_iter = int(max_iter)
     min_iter = int(min_iter)
     diag_every = int(diag_every)
-    buf_len = max_iter + max(int(policy.max_extra_iters), 0)
+    resume_state = dict(resume_state or {})
+    # the loss buffer must hold the larger of the configured and any
+    # resumed (already-extended) budget PLUS the full extension
+    # headroom — the controller may still grant up to max_extra_iters
+    # beyond whichever budget the resumed fit continues under
+    buf_len = max(max_iter, int(resume_state.get("budget", 0))) \
+        + max(int(policy.max_extra_iters), 0)
 
     if opt_state0 is None:
         params0 = jax.tree_util.tree_map(jnp.asarray, params0)
@@ -513,10 +562,18 @@ def _fit_map_controlled(loss_fn: Callable, params0: dict, loss_args: tuple,
     i0_host = 0
     losses = jnp.zeros((buf_len,), jnp.float32)
     if losses_prefix is not None and len(losses_prefix) > 0:
-        i0_host = min(int(len(losses_prefix)), max_iter)
+        i0_host = min(int(len(losses_prefix)), buf_len)
         losses = losses.at[:i0_host].set(
             jnp.asarray(losses_prefix[:i0_host], jnp.float32))
     diag = jnp.zeros((DIAG_RING, 3), jnp.float32)
+    # diag_i0 anchors the ring's slot->iteration mapping: 0 for a fresh
+    # fit, and for a resumed fit WITH a restored ring still the original
+    # fit's start — the restored slots cover the pre-resume samples, so
+    # the doctor reads the same window an uninterrupted run would
+    diag_i0 = i0_host
+    if resume_state.get("diag") is not None:
+        diag = jnp.asarray(np.asarray(resume_state["diag"], np.float32))
+        diag_i0 = int(resume_state.get("diag_i0", 0))
 
     static_kwargs = dict(conv_window=min(9, max_iter), b1=float(b1),
                          b2=float(b2), diag_every=diag_every)
@@ -526,14 +583,15 @@ def _fit_map_controlled(loss_fn: Callable, params0: dict, loss_args: tuple,
     as_f32 = functools.partial(jnp.asarray, dtype=jnp.float32)
     rel_tol_arr = as_f32(float(rel_tol))
     min_iter_arr = as_i32(min_iter)
-    lr_now = float(learning_rate)
+    lr_now = float(resume_state.get("lr", learning_rate))
 
     timings: dict = {"trace": 0.0, "compile": 0.0}
     probe_args = (params0, opt_state0, losses, diag, as_i32(i0_host),
                   as_i32(min(i0_host + diag_every, max_iter)),
                   min_iter_arr, rel_tol_arr, as_f32(lr_now), loss_args)
     compiled = _resolve_program(_run_fit_chunk, "chunk", loss_fn,
-                                probe_args, {}, static_kwargs, timings)
+                                probe_args, {}, static_kwargs, timings,
+                                compile_deadline=compile_deadline)
 
     def run_chunk(params, opt_state, losses, diag, i_host, stop_host,
                   lr_val):
@@ -546,27 +604,238 @@ def _fit_map_controlled(loss_fn: Callable, params0: dict, loss_args: tuple,
 
     params, opt_state = params0, opt_state0
     i_host = i0_host
-    budget = max_iter
+    budget = int(resume_state.get("budget", max_iter))
     decisions: list = []
-    reseeds = extra_granted = nan_retries = 0
+    reseeds = int(resume_state.get("reseeds", 0))
+    extra_granted = int(resume_state.get("extra_granted", 0))
+    nan_retries = int(resume_state.get("nan_retries", 0))
     converged_flag = nan_flag = False
-    best_loss = float("inf")
-    best_params, best_it = params0, i0_host
-    prev_verdict = None
+    best_loss = float(resume_state.get("best_loss", float("inf")))
+    best_it = int(resume_state.get("best_it", i0_host))
+    best_params = params0
+    if resume_state.get("best_params") is not None:
+        best_params = jax.tree_util.tree_map(
+            jnp.asarray, dict(resume_state["best_params"]))
+    prev_verdict = resume_state.get("prev_verdict") or None
     # iteration the current trajectory regime began at: 0 for a fresh
     # or resumed fit (a resume continues the same trajectory), bumped
     # by reseed / NaN retry so the stagnation stop measures the
     # restarted segment on its own terms
-    stagnation_anchor = 0
+    stagnation_anchor = int(resume_state.get("stagnation_anchor", 0))
+
+    fault_site = f"{escalate_tag}/chunk"
+    # live-state snapshot for the emergency save: seeded with the entry
+    # state so even a first-chunk abort leaves resumable state — for a
+    # RESUMED fit that includes the restored loss prefix (an abort
+    # before the first fetch must not supersede the iteration-N
+    # checkpoint with a zero-iteration one)
+    entry_losses = None
+    if losses_prefix is not None and i0_host:
+        entry_losses = np.asarray(losses_prefix[:i0_host], np.float32)
+    snap: dict = dict(
+        params=params, opt_state=opt_state, losses_np=entry_losses,
+        i_host=i_host, best_params=best_params, best_it=best_it,
+        best_loss=best_loss, diag=diag, diag_i0=diag_i0,
+        reseeds=reseeds, extra_granted=extra_granted,
+        nan_retries=nan_retries, lr=lr_now, budget=budget,
+        stagnation_anchor=stagnation_anchor, prev_verdict=prev_verdict)
 
     t0 = time.perf_counter()
+    try:
+        (i_host, params, opt_state, losses, diag, losses_np,
+         converged_flag, nan_flag, budget, decisions, best_loss,
+         best_params, best_it, lr_now, reseeds, extra_granted,
+         nan_retries, prev_verdict, stagnation_anchor) = _chunk_loop(
+            run_chunk=run_chunk, params=params, opt_state=opt_state,
+            losses=losses, diag=diag, i_host=i_host, budget=budget,
+            lr_now=lr_now, policy=policy, min_iter=min_iter,
+            diag_every=diag_every, diag_i0=diag_i0, b1=b1, b2=b2,
+            escalate_dir=escalate_dir, escalate_tag=escalate_tag,
+            fault_site=fault_site, chunk_deadline=chunk_deadline,
+            checkpoint_every=checkpoint_every,
+            checkpoint_cb=checkpoint_cb,
+            decisions=decisions, best_loss=best_loss,
+            best_params=best_params, best_it=best_it, reseeds=reseeds,
+            extra_granted=extra_granted, nan_retries=nan_retries,
+            prev_verdict=prev_verdict,
+            stagnation_anchor=stagnation_anchor, snap=snap)
+    except BaseException:
+        _emergency_save(checkpoint_cb, snap)
+        raise
+
+    n = i_host
+    losses_host = losses_np[:n] if losses_np is not None \
+        else np.asarray(losses)[:n]
+    diagnostics = _decode_diag(np.asarray(diag), n, diag_i0, diag_every)
+    timings["fit"] = time.perf_counter() - t0
+    health = _diagnose(losses_host, converged_flag, nan_flag,
+                       diagnostics, doctor_thresholds)
+    return FitResult(
+        params=params,
+        losses=losses_host,
+        num_iters=n,
+        converged=converged_flag,
+        nan_abort=nan_flag,
+        opt_state=opt_state,
+        timings=timings,
+        diagnostics=diagnostics,
+        verdict=health["verdict"],
+        health=health,
+        decisions=decisions,
+        budget=int(budget),
+    )
+
+
+def _np_tree_or_none(tree):
+    """Host copy of a pytree, or None when any leaf was donated away
+    (mid-chunk failure: the consumed carries are gone)."""
+    if tree is None:
+        return None
+    try:
+        return jax.tree_util.tree_map(np.asarray, tree)
+    except Exception:  # pertlint: disable=PL011 — this IS the
+        # deleted-buffer probe: None is the answer ("donated away"),
+        # which the caller reports via the inexact_checkpoint event
+        return None
+
+
+def _emergency_save(checkpoint_cb, snap: dict) -> None:
+    """Best-effort resumable save on the way out of an escaping
+    exception, from the chunk loop's live-state snapshot.  At the top
+    of the host loop every carry is a live chunk OUTPUT, so a graceful
+    preemption saves exact state; after a mid-chunk failure the donated
+    carries are gone and the save degrades to params + loss prefix
+    (resume restarts the Adam moments there — the documented rescue
+    tolerance, reported via ``exact=False``)."""
+    if checkpoint_cb is None or not snap:
+        return
+    try:
+        p_np = _np_tree_or_none(snap.get("params"))
+        o_np = _np_tree_or_none(snap.get("opt_state"))
+        i_host = int(snap.get("i_host", 0))
+        best_it = int(snap.get("best_it", 0))
+        l = snap.get("losses_np")
+        l_np = np.asarray(l[:i_host]) if l is not None \
+            else np.zeros(0, np.float32)
+        if p_np is None:
+            p_np, o_np = _np_tree_or_none(snap.get("best_params")), None
+            l_np = l_np[:best_it]
+        if p_np is None:
+            return
+        diag_np = _np_tree_or_none(snap.get("diag"))
+        state = {
+            "reseeds": int(snap.get("reseeds", 0)),
+            "extra_granted": int(snap.get("extra_granted", 0)),
+            "nan_retries": int(snap.get("nan_retries", 0)),
+            "lr": float(snap.get("lr", 0.0)),
+            "budget": int(snap.get("budget", 0)),
+            "stagnation_anchor": int(snap.get("stagnation_anchor", 0)),
+            "prev_verdict": snap.get("prev_verdict"),
+            "best_loss": float(snap.get("best_loss", float("inf"))),
+            "best_it": best_it,
+            "best_params": _np_tree_or_none(snap.get("best_params")),
+            # a donated ring degrades to an empty one anchored at the
+            # resume point: the doctor then reads only the new segment
+            "diag": diag_np if diag_np is not None
+            else np.zeros((DIAG_RING, 3), np.float32),
+            "diag_i0": int(snap.get("diag_i0", 0))
+            if diag_np is not None else int(len(l_np)),
+        }
+        checkpoint_cb(params=p_np, opt_state=o_np, losses=l_np,
+                      num_iters=int(len(l_np)), state=state,
+                      exact=o_np is not None)
+    except Exception as exc:  # noqa: BLE001 — the original abort must
+        # surface, not a failed rescue save
+        from scdna_replication_tools_tpu.utils.profiling import logger
+
+        logger.warning("emergency checkpoint save failed: %s", exc)
+
+
+def _chunk_loop(*, run_chunk, params, opt_state, losses, diag, i_host,
+                budget, lr_now, policy, min_iter, diag_every, diag_i0,
+                b1, b2, escalate_dir, escalate_tag, fault_site,
+                chunk_deadline, checkpoint_every, checkpoint_cb,
+                decisions, best_loss, best_params,
+                best_it, reseeds, extra_granted, nan_retries,
+                prev_verdict, stagnation_anchor, snap: dict):
+    """The host-side chunk loop of :func:`_fit_map_controlled`.
+
+    ``snap`` is the caller-owned live-state snapshot: refreshed with
+    plain reference assignments at the top of every pass (so it is
+    always current and costs nothing), consumed by
+    :func:`_emergency_save` when an exception escapes this loop.
+    Returns the full loop state so the caller packages the FitResult.
+    """
+    from scdna_replication_tools_tpu.obs import controller as _controller
+
+    losses_np = None
+    chunks_done = 0
+    converged_flag = nan_flag = False
+
     while i_host < budget:
+        snap.update(
+            params=params, opt_state=opt_state, losses_np=losses_np,
+            i_host=i_host, best_params=best_params, best_it=best_it,
+            best_loss=best_loss, diag=diag, diag_i0=diag_i0,
+            reseeds=reseeds, extra_granted=extra_granted,
+            nan_retries=nan_retries, lr=lr_now, budget=budget,
+            stagnation_anchor=stagnation_anchor,
+            prev_verdict=prev_verdict)
+        # periodic durability point, at the TOP of the loop pass: here
+        # every carry is a live chunk output AND every controller
+        # evaluation for the completed chunks has already been applied,
+        # so a resume from this snapshot replays the uninterrupted
+        # run's remaining chunk grid and decision trail bit-exactly.
+        # (Saving before the evaluation would make the resumed run skip
+        # one evaluation and diverge.)  Cadence counts dispatched
+        # chunks — a non-trajectory quantity, so saving never perturbs
+        # the fit it snapshots.
+        if checkpoint_cb is not None and checkpoint_every \
+                and chunks_done and losses_np is not None \
+                and chunks_done % int(checkpoint_every) == 0:
+            checkpoint_cb(
+                params=jax.tree_util.tree_map(np.asarray, params),
+                opt_state=jax.tree_util.tree_map(np.asarray, opt_state),
+                losses=losses_np[:i_host], num_iters=i_host,
+                state={
+                    "reseeds": reseeds, "extra_granted": extra_granted,
+                    "nan_retries": nan_retries, "lr": lr_now,
+                    "budget": budget,
+                    "stagnation_anchor": stagnation_anchor,
+                    "prev_verdict": prev_verdict,
+                    "best_loss": best_loss, "best_it": best_it,
+                    "best_params": _np_tree_or_none(best_params),
+                    "diag": np.asarray(diag), "diag_i0": diag_i0,
+                }, exact=True)
+
+        # injection site at the top of the loop: every carry is a live
+        # chunk output here, so a simulated preemption aborts with
+        # exactly-resumable state (the emergency hook in the caller)
+        poison = _faults.point(fault_site) == "nan"
+
         chunk_entry_params, chunk_entry_it = params, i_host
-        (i, params, opt_state, losses, diag, converged,
-         is_nan) = run_chunk(params, opt_state, losses, diag, i_host,
-                             min(i_host + diag_every, budget), lr_now)
-        i_host = int(i)
-        losses_np = np.asarray(losses)
+
+        def _dispatch():
+            out = run_chunk(params, opt_state, losses, diag, i_host,
+                            min(i_host + diag_every, budget), lr_now)
+            # the blocking host fetch happens INSIDE the deadline: a
+            # stalled device-to-host transfer is precisely the hang
+            # the chunk watchdog exists to catch
+            return out, int(out[0]), np.asarray(out[3])
+
+        out, i_host, losses_np = _faults.run_with_deadline(
+            _dispatch, chunk_deadline, f"{escalate_tag} fit chunk")
+        (_, params, opt_state, losses, diag, converged, is_nan) = out
+        chunks_done += 1
+        if poison:
+            # the injected-NaN fault: poison the chunk's last recorded
+            # loss so everything DOWNSTREAM of detection — escalation
+            # decision, diagnosable checkpoint, reduced-LR retry,
+            # rewind-and-overwrite — is the real machinery, not a mock
+            # (np.asarray of a device buffer is read-only; copy first)
+            losses_np = np.array(losses_np)
+            losses_np[max(i_host - 1, 0)] = np.nan
+            is_nan = True
         traj = losses_np[:i_host]
         # best-loss checkpoint at chunk granularity: the params that
         # ENTERED this chunk scored losses[entry_it] (computed inside
@@ -615,7 +884,7 @@ def _fit_map_controlled(loss_fn: Callable, params0: dict, loss_args: tuple,
         if converged_flag:
             break  # the reference's own rel-tol criterion fired
 
-        d = _decode_diag(np.asarray(diag), i_host, i0_host, diag_every)
+        d = _decode_diag(np.asarray(diag), i_host, diag_i0, diag_every)
         grad = d["grad_norm"] if len(d["iter"]) else None
         decision, prev_verdict = _controller.evaluate(
             policy, losses=traj, it=i_host, budget=budget,
@@ -665,26 +934,10 @@ def _fit_map_controlled(loss_fn: Callable, params0: dict, loss_args: tuple,
             # pre-reseed global best
             stagnation_anchor = i_host
 
-    n = i_host
-    losses_host = np.asarray(losses)[:n]
-    diagnostics = _decode_diag(np.asarray(diag), n, i0_host, diag_every)
-    timings["fit"] = time.perf_counter() - t0
-    health = _diagnose(losses_host, converged_flag, nan_flag,
-                       diagnostics, doctor_thresholds)
-    return FitResult(
-        params=params,
-        losses=losses_host,
-        num_iters=n,
-        converged=converged_flag,
-        nan_abort=nan_flag,
-        opt_state=opt_state,
-        timings=timings,
-        diagnostics=diagnostics,
-        verdict=health["verdict"],
-        health=health,
-        decisions=decisions,
-        budget=int(budget),
-    )
+    return (i_host, params, opt_state, losses, diag, losses_np,
+            converged_flag, nan_flag, budget, decisions, best_loss,
+            best_params, best_it, lr_now, reseeds, extra_granted,
+            nan_retries, prev_verdict, stagnation_anchor)
 
 
 def _save_escalation_checkpoint(escalate_dir, tag, params, losses,

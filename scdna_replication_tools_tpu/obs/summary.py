@@ -143,6 +143,21 @@ def summarize_events(events: List[dict]) -> dict:
         "rescues": _of(events, "rescue"),
         "nan_aborts": _of(events, "nan_abort"),
         "checkpoints": _of(events, "checkpoint"),
+        # the durability trail (schema v4): fault injections, transient
+        # retries, degradation-ladder rungs and resume decisions.  All
+        # empty on pre-v4 logs — the report renders a placeholder then.
+        "resilience": {
+            "faults": _of(events, "fault_injected"),
+            "retries": _of(events, "retry"),
+            "degrades": _of(events, "degrade"),
+            "resumes": _of(events, "resume"),
+            "checkpoint_saves": sum(
+                1 for ev in _of(events, "checkpoint")
+                if ev.get("action") == "save"),
+            "checkpoint_loads": sum(
+                1 for ev in _of(events, "checkpoint")
+                if ev.get("action") == "load"),
+        },
     }
 
 

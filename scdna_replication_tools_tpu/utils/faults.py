@@ -1,0 +1,424 @@
+"""Deterministic fault injection + the exception taxonomy behind the
+retry/degradation ladder.
+
+The ROADMAP's target regimes (multi-hour TPU batteries over a flaky
+tunnel, preemptible mesh slices, a persistent multi-tenant service)
+make process death, OOM and hangs the NORMAL case — and a failure mode
+you cannot reproduce on demand is one you cannot test a recovery path
+for.  This module provides both halves of that story:
+
+* a **fault plan** (:class:`FaultPlan`): a seeded, deterministic
+  schedule of simulated faults parsed from a compact spec string
+  (``PertConfig.faults`` / ``--faults`` / the ``PERT_FAULTS`` env var).
+  Instrumented code declares *injection sites* by calling
+  :func:`point`; the plan decides — by exact site name and 1-based hit
+  count, never by wall clock or randomness — whether that hit fails.
+  Every firing is audited as a ``fault_injected`` RunLog event (schema
+  v4).  With no plan installed (the default), :func:`point` is one
+  global ``is None`` check — provably inert;
+
+* the **exception taxonomy** (:func:`classify_exception`): maps an
+  exception to ``preemption`` / ``oom`` / ``hang`` / ``transient`` /
+  ``deterministic``, which is the whole policy input of the recovery
+  ladder in ``infer/runner.py`` — transient errors get bounded
+  exponential backoff (:func:`retry_call`), OOM walks the degradation
+  ladder, preemptions and hangs abort with a resumable checkpoint,
+  deterministic errors propagate untouched (retrying a real bug only
+  hides it);
+
+* a **watchdog** (:func:`run_with_deadline`): runs a blocking call in
+  a daemon thread with a hard deadline, converting a hang (a compile
+  that never returns over a dead tunnel, a fit chunk whose transfer
+  stalled) into a typed :class:`WatchdogTimeout` the caller can
+  checkpoint and abort on — a diagnosable artifact instead of the
+  battery's rc=124.
+
+Fault spec grammar (comma-separated rules)::
+
+    KIND@SITE            fire on the 1st hit of SITE
+    KIND@SITE#N          fire on the N-th hit (1-based)
+    KIND@SITE#N-M        fire on hits N..M inclusive
+    KIND@SITE#*          fire on every hit
+    hang@SITE#N:SECS     the hang kind takes a sleep duration
+
+with KIND one of ``preempt`` (raises :class:`SimulatedPreemption`),
+``oom`` (raises :class:`SimulatedResourceExhausted`), ``transient``
+(raises :class:`SimulatedTransientError` — exercises the
+retry-resumes-from-checkpoint ladder), ``nan`` (returned to the
+caller, which poisons the chunk so the REAL NaN-escalation machinery
+runs), ``corrupt`` (returned to the checkpoint writer, which truncates
+the file it just wrote), ``hang`` (sleeps ``SECS``, default 30 — long
+enough to trip any configured watchdog).  Example::
+
+    --faults 'preempt@step2/chunk#2,corrupt@step2/save'
+
+Site names are stable strings owned by the call sites:
+``{step}/start``, ``{step}/chunk``, ``{step}/save``, ``{step}/end``,
+``compile``, ``{prefix}/decode``, ``qc/ppc`` (see OBSERVABILITY.md,
+"Durable runs").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from scdna_replication_tools_tpu.utils.profiling import logger
+
+FAULT_KINDS = ("preempt", "oom", "nan", "corrupt", "hang", "transient")
+
+ENV_VAR = "PERT_FAULTS"
+
+
+class SimulatedPreemption(BaseException):
+    """A simulated host/TPU-slice preemption at an injection site.
+
+    Derives from BaseException (like KeyboardInterrupt): preemption is
+    NOT an error any handler should swallow or retry — the process is
+    going away, and the only correct responses are the graceful
+    checkpoint hooks that run on the way out.
+    """
+
+    def __init__(self, site: str, hit: int):
+        super().__init__(f"simulated preemption at {site} (hit {hit})")
+        self.site = site
+        self.hit = hit
+
+
+class SimulatedResourceExhausted(RuntimeError):
+    """A simulated RESOURCE_EXHAUSTED (device OOM) — the message matches
+    the marker :func:`classify_exception` keys on, so the simulated
+    fault exercises exactly the classification path a real XLA OOM
+    takes."""
+
+    def __init__(self, site: str, hit: int):
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: simulated out-of-memory at {site} "
+            f"(hit {hit})")
+        self.site = site
+
+
+class SimulatedTransientError(ConnectionError):
+    """A simulated transient infrastructure failure (tunnel drop,
+    UNAVAILABLE) — a ConnectionError so :func:`classify_exception`
+    routes it through the same ``transient`` branch a real one takes,
+    driving the retry-resumes-from-checkpoint ladder end to end."""
+
+    def __init__(self, site: str, hit: int):
+        super().__init__(
+            f"UNAVAILABLE: simulated transient failure at {site} "
+            f"(hit {hit})")
+        self.site = site
+
+
+class WatchdogTimeout(RuntimeError):
+    """A watchdog deadline fired: the wrapped call is presumed hung."""
+
+    def __init__(self, label: str, seconds: float):
+        super().__init__(
+            f"watchdog: {label!r} exceeded its {seconds:g}s deadline — "
+            f"presumed hung (dead tunnel / stalled transfer); aborting "
+            f"with a resumable checkpoint instead of hanging to rc=124")
+        self.label = label
+        self.seconds = seconds
+
+
+# ---------------------------------------------------------------------------
+# fault plan
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultRule:
+    kind: str        # one of FAULT_KINDS
+    site: str        # exact site-name match
+    first: int = 1   # 1-based hit range [first, last]; last=None => open
+    last: Optional[int] = 1
+    arg: Optional[float] = None   # hang duration
+
+    def matches(self, site: str, hit: int) -> bool:
+        if site != self.site or hit < self.first:
+            return False
+        return self.last is None or hit <= self.last
+
+
+def _parse_rule(token: str) -> FaultRule:
+    token = token.strip()
+    if "@" not in token:
+        raise ValueError(f"fault rule {token!r}: expected KIND@SITE[#N]")
+    kind, rest = token.split("@", 1)
+    kind = kind.strip().lower()
+    if kind not in FAULT_KINDS:
+        raise ValueError(f"fault rule {token!r}: unknown kind {kind!r} "
+                         f"(one of {', '.join(FAULT_KINDS)})")
+    arg = None
+    if ":" in rest:
+        rest, arg_s = rest.rsplit(":", 1)
+        arg = float(arg_s)
+    first, last = 1, 1
+    if "#" in rest:
+        rest, hits = rest.rsplit("#", 1)
+        hits = hits.strip()
+        if hits == "*":
+            first, last = 1, None
+        elif "-" in hits:
+            a, b = hits.split("-", 1)
+            first, last = int(a), int(b)
+        else:
+            first = last = int(hits)
+    site = rest.strip()
+    if not site:
+        raise ValueError(f"fault rule {token!r}: empty site")
+    return FaultRule(kind=kind, site=site, first=first, last=last, arg=arg)
+
+
+class FaultPlan:
+    """A parsed, deterministic fault schedule with per-site hit counters.
+
+    The plan carries no randomness at all: two processes running the
+    same pipeline under the same spec fire the same faults at the same
+    sites — which is what lets the chaos suite assert kill-and-resume
+    parity against a golden run.
+    """
+
+    def __init__(self, rules: List[FaultRule], spec: str = ""):
+        self.rules = list(rules)
+        self.spec = spec
+        self._hits: Dict[str, int] = {}
+        self._fired: List[dict] = []   # audit trail (also in the RunLog)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "FaultPlan":
+        rules = [_parse_rule(tok) for tok in spec.split(",") if tok.strip()]
+        return cls(rules, spec=spec)
+
+    @property
+    def fired(self) -> List[dict]:
+        return list(self._fired)
+
+    def check(self, site: str) -> Optional[FaultRule]:
+        """Count one hit of ``site``; return the matching rule, if any.
+
+        Counting is per-site and lock-protected (the watchdog thread may
+        race the main thread at a site); the FIRST matching rule wins.
+        """
+        with self._lock:
+            hit = self._hits.get(site, 0) + 1
+            self._hits[site] = hit
+        for rule in self.rules:
+            if rule.matches(site, hit):
+                record = {"site": site, "kind": rule.kind, "hit": hit}
+                self._fired.append(record)
+                return rule
+        return None
+
+
+_ACTIVE: Optional[FaultPlan] = None
+
+
+def install(plan: Optional[FaultPlan]) -> None:
+    """Install (or clear, with None) the process-wide fault plan.
+
+    Process-global on purpose: the injection sites live in layers
+    (``infer/svi``'s chunk loop, the AOT compile path) that have no
+    config plumbing, exactly like the RunLog's :func:`obs.runlog.current`
+    seam.  The runner installs the plan its config names; tests install
+    and clear around each case.
+    """
+    global _ACTIVE
+    _ACTIVE = plan
+
+
+def active() -> Optional[FaultPlan]:
+    return _ACTIVE
+
+
+def resolve_plan(config_value: Optional[str]) -> Optional[FaultPlan]:
+    """FaultPlan from ``PertConfig.faults``, falling back to the
+    ``PERT_FAULTS`` env var; None when neither is set (the default).
+
+    A malformed spec raises immediately — a chaos run whose faults
+    silently failed to parse would masquerade as a clean pass.
+    """
+    spec = config_value if config_value else os.environ.get(ENV_VAR)
+    if not spec or str(spec).lower() in ("none", "off", ""):
+        return None
+    return FaultPlan.from_spec(str(spec))
+
+
+def point(site: str) -> Optional[str]:
+    """Declare one hit of a fault-injection site.
+
+    Inert path: with no plan installed this is a single global check.
+    With a plan, a matching rule acts by kind — ``preempt``/``oom``
+    raise, ``hang`` sleeps its duration (so a configured watchdog sees
+    a real stall), ``nan``/``corrupt`` are returned for the caller to
+    apply (the effect needs caller state: the chunk's loss buffer, the
+    checkpoint file just written).  Every firing emits a
+    ``fault_injected`` RunLog event before acting, so the audit trail
+    survives even the raising kinds.
+    """
+    plan = _ACTIVE
+    if plan is None:
+        return None
+    rule = plan.check(site)
+    if rule is None:
+        return None
+    hit = plan._hits[site]
+    from scdna_replication_tools_tpu.obs import runlog as _runlog
+
+    _runlog.current().emit(
+        "fault_injected", site=site, kind=rule.kind, hit=hit,
+        detail=f"fault plan {plan.spec!r} fired {rule.kind} at {site} "
+               f"(hit {hit})")
+    logger.warning("fault injection: %s at %s (hit %d)", rule.kind, site,
+                   hit)
+    if rule.kind == "preempt":
+        raise SimulatedPreemption(site, hit)
+    if rule.kind == "oom":
+        raise SimulatedResourceExhausted(site, hit)
+    if rule.kind == "transient":
+        raise SimulatedTransientError(site, hit)
+    if rule.kind == "hang":
+        time.sleep(rule.arg if rule.arg is not None else 30.0)
+        return "hang"
+    return rule.kind   # "nan" / "corrupt": caller applies the effect
+
+
+def corrupt_file(path: str, keep_bytes: int = 128) -> None:
+    """The ``corrupt`` fault's effect: truncate ``path`` to a readable-
+    looking prefix (a partial write — the classic preempted-mid-
+    checkpoint artifact the loader must detect, not crash on)."""
+    try:
+        size = os.path.getsize(path)
+        with open(path, "r+b") as fh:
+            fh.truncate(min(keep_bytes, size))
+    except OSError as exc:
+        logger.warning("fault injection: could not corrupt %s (%s)", path,
+                       exc)
+
+
+# ---------------------------------------------------------------------------
+# exception taxonomy
+# ---------------------------------------------------------------------------
+
+# substring markers on str(exc) (case-sensitive where gRPC/XLA status
+# codes are; the lowercase ones catch prose messages)
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Resource exhausted",
+                "out of memory", "Out of memory", "OOM")
+_TRANSIENT_MARKERS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED",
+                      "CANCELLED", "UNKNOWN: Stream removed",
+                      "connection reset", "Connection reset",
+                      "Broken pipe", "socket closed", "EOF detected",
+                      "failed to connect")
+
+
+def classify_exception(exc: BaseException) -> str:
+    """Map an exception to the recovery ladder's vocabulary.
+
+    Returns one of ``preemption`` / ``oom`` / ``hang`` / ``transient``
+    / ``deterministic``.  The default is ``deterministic``: retrying an
+    unrecognised error hides real bugs, so anything not positively
+    identified as recoverable propagates untouched.
+    """
+    if isinstance(exc, SimulatedPreemption) \
+            or isinstance(exc, KeyboardInterrupt):
+        return "preemption"
+    if isinstance(exc, WatchdogTimeout):
+        return "hang"
+    text = f"{type(exc).__name__}: {exc}"
+    if isinstance(exc, MemoryError) \
+            or any(m in text for m in _OOM_MARKERS):
+        return "oom"
+    if isinstance(exc, (ConnectionError, TimeoutError)) \
+            or any(m in text for m in _TRANSIENT_MARKERS):
+        return "transient"
+    return "deterministic"
+
+
+def retry_call(fn: Callable, *, label: str, max_attempts: int = 2,
+               base_delay: float = 0.5, max_delay: float = 30.0,
+               sleep: Callable[[float], None] = time.sleep,
+               on_retry: Optional[Callable[[int], None]] = None):
+    """``fn()`` with bounded exponential backoff on TRANSIENT errors.
+
+    ``max_attempts`` counts the retries (total calls = 1 + retries);
+    delays are the deterministic ladder ``base_delay * 2**k`` capped at
+    ``max_delay`` — no jitter, because reproducible chaos tests need
+    reproducible schedules and a single client retrying a point
+    endpoint gains nothing from it.  Every retry emits a ``retry``
+    RunLog event; non-transient classes propagate immediately.
+    ``on_retry(attempt)`` runs before each retry (the runner reloads
+    its in-flight checkpoint there so the retry resumes, not restarts).
+    """
+    from scdna_replication_tools_tpu.obs import runlog as _runlog
+
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except Exception as exc:
+            kind = classify_exception(exc)
+            if kind != "transient" or attempt >= max_attempts:
+                raise
+            delay = min(base_delay * (2 ** attempt), max_delay)
+            attempt += 1
+            _runlog.current().emit(
+                "retry", label=label, attempt=attempt,
+                max_attempts=int(max_attempts),
+                delay_seconds=round(float(delay), 3),
+                error_class=kind,
+                error=f"{type(exc).__name__}: {str(exc)[:300]}")
+            logger.warning(
+                "transient failure in %s (%s: %s) — retry %d/%d after "
+                "%.2fs", label, type(exc).__name__, str(exc)[:200],
+                attempt, max_attempts, delay)
+            sleep(delay)
+            if on_retry is not None:
+                on_retry(attempt)
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+
+
+def run_with_deadline(fn: Callable, seconds: Optional[float], label: str):
+    """Run ``fn()`` under a hard deadline; raise :class:`WatchdogTimeout`
+    if it does not return in time.
+
+    ``seconds`` None/0 runs ``fn`` inline (no thread, zero overhead) —
+    the watchdog is opt-in per phase (``PertConfig.watchdog_*``).  On
+    timeout the worker thread is abandoned (a daemon — Python cannot
+    interrupt a call blocked inside a C extension), which is exactly
+    the trade: the process gets to save a resumable checkpoint and
+    exit diagnosably instead of hanging until an external timeout
+    kills it with nothing written.
+    """
+    if not seconds or seconds <= 0:
+        return fn()
+    box: dict = {}
+    done = threading.Event()
+
+    def _target():
+        try:
+            box["value"] = fn()
+        except BaseException as exc:  # pertlint: disable=PL011 — the
+            # cross-thread re-raise: the waiter below raises box["error"]
+            # in the caller's thread
+            box["error"] = exc
+        finally:
+            done.set()
+
+    worker = threading.Thread(target=_target, daemon=True,
+                              name=f"pert-watchdog-{label}")
+    worker.start()
+    if not done.wait(float(seconds)):
+        raise WatchdogTimeout(label, float(seconds))
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")

@@ -177,8 +177,10 @@ def enable_persistent_compile_cache(cache_dir) -> str | None:
             from jax._src import compilation_cache as _cc
 
             _cc.reset_cache()
-        except Exception:  # noqa: BLE001 — private API; best effort
-            pass
+        except Exception as exc:  # noqa: BLE001 — private API
+            logger.debug("compile cache: reset_cache unavailable (%s); "
+                         "the old in-memory cache may serve a few more "
+                         "hits", exc)
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

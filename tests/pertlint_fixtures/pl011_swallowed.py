@@ -1,0 +1,95 @@
+"""Fixture for PL011 (swallowed-exception-in-library).
+
+Parsed by the lint tests, never imported.  Lines ending in the expect
+marker must fire; the inline-disable line must land in the suppressed
+list.  The rule targets BROAD handlers (bare ``except:``,
+``except Exception:``, ``except BaseException:``) whose body neither
+re-raises nor reports (RunLog ``.emit``, a logger call,
+``warnings.warn``); narrow handlers and reporting handlers are exempt.
+"""
+
+import warnings
+
+from scdna_replication_tools_tpu.utils.profiling import logger
+
+
+def silent_swallow_fires(fn):
+    try:
+        return fn()
+    except Exception:  # expect: PL011
+        return None
+
+
+def bare_except_fires(fn):
+    try:
+        return fn()
+    except:  # noqa: E722  # expect: PL011
+        pass
+
+
+def base_exception_fires(fn):
+    try:
+        return fn()
+    except BaseException:  # expect: PL011
+        return None
+
+
+def tuple_with_broad_member_fires(fn):
+    try:
+        return fn()
+    except (ValueError, Exception):  # expect: PL011
+        return None
+
+
+def narrow_handler_is_exempt(fn):
+    try:
+        return fn()
+    except OSError:   # a considered decision about one failure mode
+        return None
+
+
+def reraise_is_exempt(fn):
+    try:
+        return fn()
+    except Exception as exc:
+        raise RuntimeError("wrapped") from exc
+
+
+def conditional_reraise_is_exempt(fn, classify):
+    try:
+        return fn()
+    except Exception as exc:
+        if classify(exc) != "transient":
+            raise
+        return None
+
+
+def logger_call_is_exempt(fn):
+    try:
+        return fn()
+    except Exception as exc:
+        logger.warning("best-effort path failed: %s", exc)
+        return None
+
+
+def runlog_emit_is_exempt(fn, run_log):
+    try:
+        return fn()
+    except Exception as exc:
+        run_log.emit("note", error=str(exc))
+        return None
+
+
+def warnings_warn_is_exempt(fn):
+    try:
+        return fn()
+    except Exception as exc:
+        warnings.warn(f"degraded: {exc}")
+        return None
+
+
+def deliberate_swallow_is_suppressible(fn):
+    try:
+        return fn()
+    except Exception:  # pertlint: disable=PL011 — probe by design
+        return None

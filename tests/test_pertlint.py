@@ -44,6 +44,7 @@ FIXTURES = {
     "PL008": FIXTURE_DIR / "pl008_print.py",
     "PL009": FIXTURE_DIR / "pl009_event_kinds.py",
     "PL010": FIXTURE_DIR / "pl010_control_actions.py",
+    "PL011": FIXTURE_DIR / "pl011_swallowed.py",
 }
 
 
@@ -191,6 +192,8 @@ def _seed_violation(rule_id):
         "PL010": ("\ndef seeded(run_log):\n"
                   "    run_log.emit('control_decision', "
                   "action='bogus_action', iter=1)\n"),
+        "PL011": ("\ndef seeded(fn):\n    try:\n        return fn()\n"
+                  "    except Exception:\n        return None\n"),
     }[rule_id]
 
 

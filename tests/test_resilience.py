@@ -1,0 +1,649 @@
+"""Durable runs: fault injection, kill-and-resume parity, degradation.
+
+The chaos suite behind ISSUE 9's acceptance bar: for every injection
+point (phase boundaries + mid-fit chunks), killing the pipeline and
+rerunning with ``resume='auto'`` must reproduce the uninterrupted
+golden run's trajectory bit-exactly — with the decision + resume trail
+reproducible from the RunLog.  Plus units for the pieces: the fault
+plan's deterministic schedule, the exception taxonomy, retry backoff,
+the watchdog, checkpoint integrity (footer, fallback, typed errors),
+the manifest's fingerprint gate, and the OOM degradation ladder.
+
+Fast subset runs in tier-1; the full kill-site matrix is ``slow``.
+"""
+
+import json
+import os
+import pathlib
+
+import numpy as np
+import pytest
+
+from scdna_replication_tools_tpu.config import PertConfig
+from scdna_replication_tools_tpu.infer import checkpoint as ckpt
+from scdna_replication_tools_tpu.infer import manifest as manifest_mod
+from scdna_replication_tools_tpu.infer.runner import (
+    PertInference,
+    _decode_with_degradation,
+)
+from scdna_replication_tools_tpu.obs.schema import validate_run
+from scdna_replication_tools_tpu.utils import faults as faults_mod
+
+from conftest import dense_inputs_from_frames as _dense_inputs  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clear_fault_plan():
+    """No fault plan may leak across tests (the runner installs them
+    process-globally by design)."""
+    yield
+    faults_mod.install(None)
+
+
+# controller ON with a non-pinned budget so the chunked (durable) fit
+# path runs; rel_tol=0 keeps budgets deterministic; extensions bounded
+# so the suite stays fast
+BASE = dict(cn_prior_method="g1_clones", rel_tol=0.0, run_step3=False,
+            max_iter=100, min_iter=25, max_iter_step1=40,
+            min_iter_step1=20, fit_diag_every=25,
+            controller_max_extra_iters=50, telemetry_path=None)
+
+
+@pytest.fixture(scope="module")
+def golden(synthetic_frames):
+    """The uninterrupted reference run every chaos case compares to."""
+    s, g1, clone_idx = _dense_inputs(synthetic_frames)
+    inf = PertInference(s, g1, PertConfig(**BASE), clone_idx_s=clone_idx,
+                        clone_idx_g1=clone_idx, num_clones=2)
+    step1, step2, _ = inf.run()
+    return inf, step1, step2
+
+
+# ---------------------------------------------------------------------------
+# fault plan
+# ---------------------------------------------------------------------------
+
+
+def test_fault_spec_parsing_and_determinism():
+    plan = faults_mod.FaultPlan.from_spec(
+        "preempt@step2/chunk#3,nan@step2/chunk#5,hang@compile#2:0.01,"
+        "oom@pkg/decode#1-2,corrupt@step2/save#*")
+    # hit counting is per-site, 1-based, deterministic
+    assert plan.check("step2/chunk") is None           # hit 1
+    assert plan.check("step2/chunk") is None           # hit 2
+    assert plan.check("step2/chunk").kind == "preempt"  # hit 3
+    assert plan.check("step2/chunk") is None           # hit 4
+    assert plan.check("step2/chunk").kind == "nan"     # hit 5
+    assert plan.check("compile") is None
+    assert plan.check("compile").kind == "hang"
+    assert plan.check("pkg/decode").kind == "oom"      # range 1-2
+    assert plan.check("pkg/decode").kind == "oom"
+    assert plan.check("pkg/decode") is None
+    for _ in range(5):
+        assert plan.check("step2/save").kind == "corrupt"   # '*'
+    assert len(plan.fired) == 10
+
+
+def test_fault_spec_rejects_garbage():
+    with pytest.raises(ValueError):
+        faults_mod.FaultPlan.from_spec("explode@somewhere")
+    with pytest.raises(ValueError):
+        faults_mod.FaultPlan.from_spec("preempt-no-site")
+
+
+def test_point_is_inert_without_a_plan():
+    faults_mod.install(None)
+    assert faults_mod.point("anything") is None
+
+
+def test_resolve_plan_env_fallback(monkeypatch):
+    monkeypatch.setenv(faults_mod.ENV_VAR, "preempt@x")
+    plan = faults_mod.resolve_plan(None)
+    assert plan is not None and plan.rules[0].site == "x"
+    assert faults_mod.resolve_plan("off") is None
+    monkeypatch.delenv(faults_mod.ENV_VAR)
+    assert faults_mod.resolve_plan(None) is None
+
+
+# ---------------------------------------------------------------------------
+# exception taxonomy + retry + watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_classify_exception_taxonomy():
+    cls = faults_mod.classify_exception
+    assert cls(faults_mod.SimulatedPreemption("s", 1)) == "preemption"
+    assert cls(KeyboardInterrupt()) == "preemption"
+    assert cls(faults_mod.WatchdogTimeout("fit", 1.0)) == "hang"
+    assert cls(faults_mod.SimulatedResourceExhausted("s", 1)) == "oom"
+    assert cls(RuntimeError("RESOURCE_EXHAUSTED: out of memory "
+                            "allocating 2.8G")) == "oom"
+    assert cls(MemoryError()) == "oom"
+    assert cls(RuntimeError("UNAVAILABLE: connection to TPU worker "
+                            "lost")) == "transient"
+    assert cls(ConnectionResetError("peer")) == "transient"
+    assert cls(TimeoutError()) == "transient"
+    # the default is deterministic: retrying unknown errors hides bugs
+    assert cls(ValueError("bad shape")) == "deterministic"
+    assert cls(RuntimeError("some internal invariant")) == "deterministic"
+
+
+def test_retry_call_retries_transient_with_backoff():
+    sleeps = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise TimeoutError("transient blip")
+        return "ok"
+
+    out = faults_mod.retry_call(flaky, label="t", max_attempts=3,
+                                base_delay=0.25, sleep=sleeps.append)
+    assert out == "ok" and calls["n"] == 3
+    assert sleeps == [0.25, 0.5]   # deterministic exponential ladder
+
+
+def test_retry_call_never_retries_deterministic_errors():
+    calls = {"n": 0}
+
+    def broken():
+        calls["n"] += 1
+        raise ValueError("a real bug")
+
+    with pytest.raises(ValueError):
+        faults_mod.retry_call(broken, label="t", max_attempts=5,
+                              sleep=lambda _: None)
+    assert calls["n"] == 1
+
+
+def test_retry_call_bounded():
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise TimeoutError("forever")
+
+    with pytest.raises(TimeoutError):
+        faults_mod.retry_call(always, label="t", max_attempts=2,
+                              sleep=lambda _: None)
+    assert calls["n"] == 3   # 1 call + 2 retries
+
+
+def test_run_with_deadline():
+    import time as _time
+
+    assert faults_mod.run_with_deadline(lambda: 42, None, "x") == 42
+    assert faults_mod.run_with_deadline(lambda: 42, 5.0, "x") == 42
+    with pytest.raises(faults_mod.WatchdogTimeout, match="hung"):
+        faults_mod.run_with_deadline(lambda: _time.sleep(2.0), 0.05, "x")
+
+    def boom():
+        raise ValueError("inner")
+
+    with pytest.raises(ValueError, match="inner"):
+        faults_mod.run_with_deadline(boom, 5.0, "x")
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity
+# ---------------------------------------------------------------------------
+
+
+def _save_dummy(tmp_path, tag="step2", value=1.0):
+    params = {"tau_raw": np.full(8, value, np.float32)}
+    return ckpt.save_step(str(tmp_path), tag, params,
+                          np.array([3.0, 2.0, float(value)], np.float32))
+
+
+def test_checkpoint_footer_roundtrip(tmp_path):
+    _save_dummy(tmp_path)
+    params, losses, extra = ckpt.load_step(str(tmp_path), "step2")
+    assert float(params["tau_raw"][0]) == 1.0
+    assert int(extra["meta.format_version"]) >= 3
+
+
+def test_truncated_checkpoint_raises_typed_error(tmp_path):
+    path = _save_dummy(tmp_path)
+    with open(path, "r+b") as fh:
+        fh.truncate(os.path.getsize(path) // 2)
+    with pytest.raises(ckpt.CheckpointCorrupt) as exc_info:
+        ckpt.load_step(str(tmp_path), "step2")
+    assert path in str(exc_info.value)
+
+
+def test_bitflip_checkpoint_raises_typed_error(tmp_path):
+    path = _save_dummy(tmp_path)
+    blob = bytearray(pathlib.Path(path).read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    pathlib.Path(path).write_bytes(bytes(blob))
+    with pytest.raises(ckpt.CheckpointCorrupt, match="sha256|truncated"):
+        ckpt.load_step(str(tmp_path), "step2")
+
+
+def test_corrupt_checkpoint_falls_back_to_retained_previous(tmp_path):
+    _save_dummy(tmp_path, value=1.0)   # becomes .prev on the next save
+    path = _save_dummy(tmp_path, value=2.0)
+    faults_mod.corrupt_file(path)
+    params, _, _ = ckpt.load_step(str(tmp_path), "step2")
+    assert float(params["tau_raw"][0]) == 1.0   # the retained previous
+
+
+def test_missing_canonical_falls_back_to_retained_previous(tmp_path):
+    """Crash between rotate and commit: the canonical file is gone but
+    the retained predecessor must be restored, not ignored."""
+    _save_dummy(tmp_path, value=1.0)
+    path = _save_dummy(tmp_path, value=2.0)
+    os.unlink(path)   # the new file never committed
+    params, _, _ = ckpt.load_step(str(tmp_path), "step2")
+    assert float(params["tau_raw"][0]) == 1.0
+
+
+def test_footerless_legacy_checkpoint_still_loads(tmp_path):
+    path = _save_dummy(tmp_path)
+    blob = pathlib.Path(path).read_bytes()
+    pathlib.Path(path).write_bytes(blob[:-48])   # strip the footer
+    params, _, _ = ckpt.load_step(str(tmp_path), "step2")
+    assert float(params["tau_raw"][0]) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# manifest
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_fingerprint_and_atomic_roundtrip(tmp_path):
+    a = np.arange(100, dtype=np.float32).reshape(10, 10)
+    fp = manifest_mod.data_fingerprint(a)
+    assert fp == manifest_mod.data_fingerprint(a.copy())
+    b = a.copy()
+    b[3, 3] += 1.0
+    assert fp != manifest_mod.data_fingerprint(b)
+    assert fp != manifest_mod.data_fingerprint(a.astype(np.float64))
+
+    m = manifest_mod.RunManifest(tmp_path)
+    m.begin_run("cfg123", fp, run_log_path="run.jsonl")
+    m.update_step("step1", "complete", num_iters=40)
+    m2 = manifest_mod.RunManifest.load(tmp_path)
+    ok, reason = m2.match("cfg123", fp)
+    assert ok and "verified" in reason
+    assert m2.step("step1")["status"] == "complete"
+    # data mismatch blocks; config mismatch only annotates
+    ok, reason = m2.match("cfg123", "deadbeef")
+    assert not ok and "mismatch" in reason
+    ok, reason = m2.match("other-config", fp)
+    assert ok and "config hash differs" in reason
+
+
+def test_manifest_corrupt_file_degrades_to_empty(tmp_path):
+    (tmp_path / manifest_mod.MANIFEST_NAME).write_text("{not json")
+    m = manifest_mod.RunManifest.load(tmp_path)
+    assert m.match("x", "y")[0] is False
+
+
+# ---------------------------------------------------------------------------
+# chaos: kill-and-resume parity
+# ---------------------------------------------------------------------------
+
+FAST_KILL_SITES = ["step2/chunk#3", "step2/start"]
+SLOW_KILL_SITES = ["step1/start", "step1/chunk#2", "step2/end"]
+
+
+def _run_pipeline(synthetic_frames, config):
+    s, g1, clone_idx = _dense_inputs(synthetic_frames)
+    inf = PertInference(s, g1, config, clone_idx_s=clone_idx,
+                        clone_idx_g1=clone_idx, num_clones=2)
+    return inf, inf.run()
+
+
+@pytest.mark.parametrize(
+    "site",
+    FAST_KILL_SITES + [pytest.param(s, marks=pytest.mark.slow)
+                       for s in SLOW_KILL_SITES])
+def test_kill_and_resume_parity(site, golden, synthetic_frames, tmp_path):
+    """Preempt at a phase boundary or mid-fit chunk, rerun with
+    resume='auto': the final trajectory and params must be bit-exact
+    against the uninterrupted golden run, and both RunLogs must
+    validate against schema v4."""
+    _, g_step1, g_step2 = golden
+    durable = dict(checkpoint_dir=str(tmp_path / "ck"),
+                   checkpoint_every=2)
+
+    cfg_kill = PertConfig(**{**BASE, **durable,
+                             "faults": f"preempt@{site}",
+                             "telemetry_path":
+                                 str(tmp_path / "killed.jsonl")})
+    with pytest.raises(faults_mod.SimulatedPreemption):
+        _run_pipeline(synthetic_frames, cfg_kill)
+    # what the kill left behind decides whether the rerun can resume
+    # at all (a preempt before the first checkpoint leaves nothing)
+    had_durable = bool(list((tmp_path / "ck").glob("pert_*.npz")))
+
+    cfg_resume = PertConfig(**{**BASE, **durable,
+                               "telemetry_path":
+                                   str(tmp_path / "resumed.jsonl")})
+    _, (r1, r2, _) = _run_pipeline(synthetic_frames, cfg_resume)
+
+    np.testing.assert_array_equal(r2.fit.losses, g_step2.fit.losses)
+    np.testing.assert_array_equal(
+        np.asarray(r2.fit.params["tau_raw"]),
+        np.asarray(g_step2.fit.params["tau_raw"]))
+    np.testing.assert_array_equal(r1.fit.losses, g_step1.fit.losses)
+    # the resumed fit re-makes exactly the decisions the golden run
+    # made AFTER the resume point (a suffix of the golden trail; the
+    # pre-kill prefix lives in the killed run's own log)
+    g_trail = [(d["action"], d["iter"]) for d in g_step2.fit.decisions]
+    r_trail = [(d["action"], d["iter"]) for d in r2.fit.decisions]
+    assert r_trail == g_trail[len(g_trail) - len(r_trail):]
+
+    # both artifacts validate against schema v4, and the resumed log
+    # carries the resume trail
+    for name in ("killed.jsonl", "resumed.jsonl"):
+        path = tmp_path / name
+        if path.exists():
+            assert validate_run(path) == [], name
+    resumed_events = [json.loads(line) for line in
+                      (tmp_path / "resumed.jsonl").read_text()
+                      .splitlines()]
+    # the resume trail appears whenever the kill left anything durable
+    # behind (a kill before the first checkpoint is a genuinely fresh
+    # rerun — e.g. preempt@step1/start)
+    if had_durable:
+        assert any(ev["event"] == "resume" for ev in resumed_events)
+    killed_events = [json.loads(line) for line in
+                     (tmp_path / "killed.jsonl").read_text().splitlines()]
+    assert any(ev["event"] == "fault_injected" for ev in killed_events)
+    assert killed_events[-1]["event"] == "run_end" \
+        and killed_events[-1]["status"] == "error"
+
+
+def test_injected_transient_failure_retries_and_resumes(golden,
+                                                        synthetic_frames,
+                                                        tmp_path):
+    """A transient fault mid-fit must be retried (bounded backoff) and
+    the retry must RESUME from the emergency checkpoint — landing on
+    the golden trajectory, with the retry audited in the run log."""
+    _, _, g_step2 = golden
+    cfg = PertConfig(**{**BASE, "checkpoint_dir": str(tmp_path / "ck"),
+                        "checkpoint_every": 2,
+                        "retry_backoff_seconds": 0.01,
+                        "faults": "transient@step2/chunk#3",
+                        "telemetry_path": str(tmp_path / "t.jsonl")})
+    _, (_, r2, _) = _run_pipeline(synthetic_frames, cfg)
+    np.testing.assert_array_equal(r2.fit.losses, g_step2.fit.losses)
+    np.testing.assert_array_equal(
+        np.asarray(r2.fit.params["tau_raw"]),
+        np.asarray(g_step2.fit.params["tau_raw"]))
+    events = [json.loads(line) for line in
+              (tmp_path / "t.jsonl").read_text().splitlines()]
+    assert any(ev["event"] == "retry" and ev["label"] == "step2/fit"
+               for ev in events)
+    assert any(ev["event"] == "resume" and ev["action"] == "resumed"
+               for ev in events)
+    assert validate_run(tmp_path / "t.jsonl") == []
+
+
+def test_injected_nan_drives_real_escalation_machinery(synthetic_frames,
+                                                       tmp_path):
+    """A nan fault poisons one chunk: the controller must escalate
+    through the diagnosable checkpoint + reduced-LR retry and finish."""
+    cfg = PertConfig(checkpoint_dir=str(tmp_path), checkpoint_every=0,
+                     faults="nan@step2/chunk#2", **BASE)
+    _, (s1, s2, _) = _run_pipeline(synthetic_frames, cfg)
+    actions = [d["action"] for d in s2.fit.decisions]
+    assert "escalate" in actions
+    esc = next(d for d in s2.fit.decisions if d["action"] == "escalate")
+    assert esc["outcome"] == "retry"
+    assert not s2.fit.nan_abort          # the retry recovered
+    assert (tmp_path / "pert_step2_nan.npz").exists()
+
+
+def test_corrupted_saves_degrade_to_refit(golden, synthetic_frames,
+                                          tmp_path):
+    """Every step2 checkpoint write corrupted: the resume run must
+    detect it (typed, audited) and refit from scratch — landing on the
+    golden trajectory, not crashing on an unpickling error."""
+    _, _, g_step2 = golden
+    cfg_a = PertConfig(checkpoint_dir=str(tmp_path),
+                       faults="corrupt@step2/save#*", **BASE)
+    _run_pipeline(synthetic_frames, cfg_a)
+    cfg_b = PertConfig(**{**BASE, "checkpoint_dir": str(tmp_path),
+                          "telemetry_path": str(tmp_path / "r.jsonl")})
+    _, (_, r2, _) = _run_pipeline(synthetic_frames, cfg_b)
+    np.testing.assert_array_equal(r2.fit.losses, g_step2.fit.losses)
+    events = [json.loads(line) for line in
+              (tmp_path / "r.jsonl").read_text().splitlines()]
+    assert any(ev["event"] == "degrade"
+               and ev["action"] == "checkpoint_discarded"
+               for ev in events)
+
+
+def test_fingerprint_mismatch_blocks_resume(synthetic_frames, tmp_path):
+    """Checkpoints fitted to OTHER data must not be restored under
+    resume='auto' — that would be silent corruption, not a resume."""
+    s, g1, clone_idx = _dense_inputs(synthetic_frames)
+    cfg = PertConfig(checkpoint_dir=str(tmp_path), **BASE)
+    inf = PertInference(s, g1, cfg, clone_idx_s=clone_idx,
+                        clone_idx_g1=clone_idx, num_clones=2)
+    inf.run()
+
+    s2, g12, clone_idx2 = _dense_inputs(synthetic_frames)
+    s2.reads[0, :] += 7.0   # different data, same shapes
+    inf2 = PertInference(s2, g12, cfg, clone_idx_s=clone_idx2,
+                         clone_idx_g1=clone_idx2, num_clones=2)
+    assert not inf2._resume_ok
+    step1, step2, _ = inf2.run()
+    assert step1.wall_time > 0 and step2.wall_time > 0   # refit, not
+    # restored
+
+
+def test_retry_can_resume_checkpoints_written_this_run(synthetic_frames,
+                                                       tmp_path):
+    """Fresh checkpoint dir: the directory identity is unverifiable at
+    construction (_resume_ok False), but a transient retry inside the
+    SAME run must still resume the checkpoints this run wrote — they
+    carry the current identity by construction."""
+    from scdna_replication_tools_tpu.infer.runner import StepOutput
+
+    s, g1, clone_idx = _dense_inputs(synthetic_frames)
+    cfg = PertConfig(checkpoint_dir=str(tmp_path), **BASE)
+    inf = PertInference(s, g1, cfg, clone_idx_s=clone_idx,
+                        clone_idx_g1=clone_idx, num_clones=2)
+    assert not inf._resume_ok   # fresh dir, nothing to verify
+    step1, _, _ = inf.run()
+    assert "step1" in inf._steps_written
+    loaded = inf._load_resumable("step1", step1.fit.budget, step1.spec,
+                                 step1.fixed, step1.batch)
+    assert isinstance(loaded, StepOutput)   # the retry path restores
+
+
+def test_resume_with_grown_budget_continues_the_fit(synthetic_frames,
+                                                    tmp_path):
+    """The documented budget-growth workflow: a fit that exhausted a
+    small budget un-converged must RESUME and run the extra iterations
+    under a larger max_iter — not restore as complete because the saved
+    controller budget was smaller."""
+    s, g1, clone_idx = _dense_inputs(synthetic_frames)
+    base = {**BASE, "controller_max_extra_iters": 0,
+            "controller_stop_patience": 0}
+    cfg_small = PertConfig(checkpoint_dir=str(tmp_path),
+                           **{**base, "max_iter": 75})
+    inf_a = PertInference(s, g1, cfg_small, clone_idx_s=clone_idx,
+                          clone_idx_g1=clone_idx, num_clones=2)
+    _, a2, _ = inf_a.run()
+    assert a2.fit.num_iters == 75 and not a2.fit.converged
+
+    cfg_big = PertConfig(checkpoint_dir=str(tmp_path),
+                         **{**base, "max_iter": 125})
+    inf_b = PertInference(s, g1, cfg_big, clone_idx_s=clone_idx,
+                          clone_idx_g1=clone_idx, num_clones=2)
+    _, b2, _ = inf_b.run()
+    assert b2.fit.num_iters == 125   # resumed AND ran the growth
+    np.testing.assert_array_equal(b2.fit.losses[:75], a2.fit.losses)
+
+
+def test_invalid_resume_value_rejected_before_manifest_mutation(
+        synthetic_frames, tmp_path):
+    """A typo'd resume value must raise BEFORE the manifest is touched
+    — a config error cannot cost durable resume state."""
+    s, g1, clone_idx = _dense_inputs(synthetic_frames)
+    cfg = PertConfig(checkpoint_dir=str(tmp_path), **BASE)
+    inf = PertInference(s, g1, cfg, clone_idx_s=clone_idx,
+                        clone_idx_g1=clone_idx, num_clones=2)
+    inf.run()
+    manifest_before = (tmp_path / "manifest.json").read_text()
+    with pytest.raises(ValueError, match="resume"):
+        PertInference(s, g1,
+                      PertConfig(checkpoint_dir=str(tmp_path),
+                                 resume="no", **BASE),
+                      clone_idx_s=clone_idx, clone_idx_g1=clone_idx,
+                      num_clones=2)
+    assert (tmp_path / "manifest.json").read_text() == manifest_before
+
+
+def test_resume_off_refits(synthetic_frames, tmp_path):
+    cfg = PertConfig(checkpoint_dir=str(tmp_path), **BASE)
+    _run_pipeline(synthetic_frames, cfg)
+    cfg_off = PertConfig(checkpoint_dir=str(tmp_path), resume="off",
+                         **BASE)
+    _, (r1, r2, _) = _run_pipeline(synthetic_frames, cfg_off)
+    assert r1.wall_time > 0 and r2.wall_time > 0
+
+
+def test_watchdog_converts_compile_hang_into_typed_abort(synthetic_frames,
+                                                         tmp_path):
+    """A hang injected inside the compile path + an armed compile
+    deadline must abort with WatchdogTimeout (classified 'hang'), not
+    sit forever — the rc=124 conversion."""
+    from scdna_replication_tools_tpu.infer import svi
+
+    svi.clear_program_cache()   # force a real compile resolution
+    try:
+        cfg = PertConfig(checkpoint_dir=str(tmp_path),
+                         faults="hang@compile#1:1.5",
+                         watchdog_compile_seconds=0.2, **BASE)
+        with pytest.raises(faults_mod.WatchdogTimeout):
+            _run_pipeline(synthetic_frames, cfg)
+    finally:
+        svi.clear_program_cache()
+
+
+# ---------------------------------------------------------------------------
+# degradation ladder
+# ---------------------------------------------------------------------------
+
+
+def test_decode_ladder_halves_slab_on_oom(golden):
+    inf, _, step2 = golden
+    faults_mod.install(faults_mod.FaultPlan.from_spec(
+        "oom@pkg/decode#1"))
+    decoded, ent, want = _decode_with_degradation(
+        step2.spec, step2.fit.params, step2.fixed, step2.batch,
+        inf._step2_data, None, True, "pkg")
+    assert want is True and ent is not None
+    assert len(decoded) == 3
+    assert faults_mod.active().fired[0]["kind"] == "oom"
+
+
+def test_decode_ladder_drops_qc_surfaces_when_halving_fails(golden):
+    inf, _, step2 = golden
+    faults_mod.install(faults_mod.FaultPlan.from_spec(
+        "oom@pkg/decode#1-4"))
+    decoded, ent, want = _decode_with_degradation(
+        step2.spec, step2.fit.params, step2.fixed, step2.batch,
+        inf._step2_data, None, True, "pkg")
+    assert want is False and ent is None
+    assert len(decoded) == 3
+
+
+def test_decode_ladder_exhausted_reraises(golden):
+    inf, _, step2 = golden
+    faults_mod.install(faults_mod.FaultPlan.from_spec(
+        "oom@pkg/decode#*"))
+    with pytest.raises(faults_mod.SimulatedResourceExhausted):
+        _decode_with_degradation(
+            step2.spec, step2.fit.params, step2.fixed, step2.batch,
+            inf._step2_data, None, True, "pkg")
+
+
+def test_decode_ladder_propagates_deterministic_errors(golden):
+    """Non-OOM errors must escape the ladder untouched from the first
+    attempt — no silent slab-halving around real bugs."""
+    inf, _, step2 = golden
+    bad_params = dict(step2.fit.params)
+    bad_params.pop("tau_raw")
+    with pytest.raises(Exception) as exc_info:
+        _decode_with_degradation(
+            step2.spec, bad_params, step2.fixed, step2.batch,
+            inf._step2_data, None, False, "pkg")
+    assert faults_mod.classify_exception(exc_info.value) \
+        == "deterministic"
+
+
+def test_ppc_oom_degrades_to_nan_columns(golden):
+    inf, _, step2 = golden
+    frac_low = np.zeros(inf._step2_data.num_cells, np.float32)
+    qc_stats = {
+        "tau": np.full(step2.batch.reads.shape[0], 0.5, np.float32),
+        "mean_cn_entropy": frac_low + 0.1,
+        "max_cn_entropy": frac_low + 0.2,
+        "frac_low_conf": frac_low,
+        "mean_rep_entropy": frac_low + 0.1,
+    }
+    faults_mod.install(faults_mod.FaultPlan.from_spec("oom@qc/ppc#1"))
+    df = inf.build_cell_qc(step2, inf._step2_data, qc_stats)
+    assert df["ppc_z"].isna().all()
+    assert not df["qc_flags"].str.contains("ppc_outlier").any()
+    # the PPC drop must not poison the non_finite flag
+    assert not df["qc_flags"].str.contains("non_finite").any()
+
+
+# ---------------------------------------------------------------------------
+# inertness + overhead guards
+# ---------------------------------------------------------------------------
+
+_V4_KINDS = {"fault_injected", "retry", "degrade", "resume"}
+
+
+def test_disabled_harness_is_inert(synthetic_frames, tmp_path):
+    """faults=None + no checkpoint_dir: the run log must carry ZERO
+    durability events — the whole layer reduces to inert checks."""
+    cfg = PertConfig(**{**BASE,
+                        "telemetry_path": str(tmp_path / "clean.jsonl")})
+    _run_pipeline(synthetic_frames, cfg)
+    assert validate_run(tmp_path / "clean.jsonl") == []
+    events = [json.loads(line) for line in
+              (tmp_path / "clean.jsonl").read_text().splitlines()]
+    assert not [ev for ev in events if ev["event"] in _V4_KINDS]
+    assert events[0]["schema_version"] == 4
+
+
+def test_periodic_checkpoint_overhead_is_bounded(synthetic_frames,
+                                                 tmp_path):
+    """Coarse tier-1 guard at the smoke shape: periodic checkpointing
+    (every 2 chunks) must not blow up the step-2 fit wall.  The bound
+    is deliberately loose — at this tiny shape the fixed npz-write cost
+    is a far larger fraction of the fit than at the flagship shape
+    PERF_NOTES pins (<2%); this guard catches pathological regressions
+    (a sync or save per iteration), not basis points."""
+    import time
+
+    s, g1, clone_idx = _dense_inputs(synthetic_frames)
+
+    def fit_wall(**extra):
+        cfg = PertConfig(**{**BASE, **extra})
+        inf = PertInference(s, g1, cfg, clone_idx_s=clone_idx,
+                            clone_idx_g1=clone_idx, num_clones=2)
+        t0 = time.perf_counter()
+        inf.run()
+        return time.perf_counter() - t0
+
+    fit_wall()   # warm the compile caches for both arms
+    walls_off = []
+    walls_on = []
+    for trial in range(3):   # interleaved: drift-robust (PERF_NOTES)
+        walls_off.append(fit_wall())
+        walls_on.append(fit_wall(
+            checkpoint_dir=str(tmp_path / f"ck{trial}"),
+            checkpoint_every=2))
+    assert np.median(walls_on) < np.median(walls_off) * 2.0 + 0.5

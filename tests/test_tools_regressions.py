@@ -138,6 +138,10 @@ def test_pert_report_renders_committed_r07_artifacts(tmp_path):
     assert "step2" in single
     assert "## Compiled programs" in single
     assert "## Mirror rescue" in single
+    # pre-v4 artifact: the Resilience section renders a placeholder,
+    # never pretends the durability trail was clean
+    assert "## Resilience" in single
+    assert "pre-v4 run log" in single
 
     out = tmp_path / "cmp.md"
     report_tool.main(["--compare", str(cold), str(warm),

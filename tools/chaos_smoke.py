@@ -1,0 +1,171 @@
+"""Chaos smoke: inject a mid-fit preemption, resume, assert parity.
+
+The CI face of the durable-runs layer (ISSUE 9): runs the smoke-shaped
+simulated pipeline three times —
+
+1. **golden** — uninterrupted, no checkpointing;
+2. **killed** — same workload with ``--faults preempt@step2/chunk#N``
+   and periodic in-fit checkpointing; dies mid-step-2 by design;
+3. **resumed** — ``resume='auto'`` against the killed run's
+   checkpoint directory; must continue the step-2 fit mid-budget.
+
+Asserts (exit 1 on any failure):
+
+* the resumed run's final per-cell ``model_tau`` matches the golden
+  run's bit-exactly;
+* the resumed RunLog validates against schema v4 and carries the
+  ``resume`` trail; the killed RunLog carries ``fault_injected`` and a
+  ``run_end`` with status ``error``;
+* the rendered report's "Resilience" section is non-placeholder.
+
+Writes the resumed run's rendered markdown report (the "Resilience"
+section CI uploads) to ``--report``.
+
+Usage::
+
+    python tools/chaos_smoke.py --out chaos_smoke.json \
+        --report chaos_resilience.md
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from tools.full_pipeline_bench import (  # noqa: E402
+    force_cpu_backend,
+    make_genome_workload,
+)
+
+
+def _infer(df_s, df_g, telemetry, **extra):
+    import numpy as np
+
+    from scdna_replication_tools_tpu.api import scRT
+
+    scrt = scRT(df_s.copy(), df_g.copy(), input_col="reads",
+                clone_col="clone_id", assign_col="copy",
+                cn_prior_method="g1_clones", max_iter=100, min_iter=25,
+                rel_tol=0.0, run_step3=False, telemetry_path=telemetry,
+                **extra)
+    cn_s_out, _, _, _ = scrt.infer(level="pert")
+    tau = cn_s_out.groupby("cell_id").agg(
+        tau=("model_tau", "first")).sort_index()["tau"].to_numpy()
+    return np.asarray(tau), scrt
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cells", type=int, default=32)
+    ap.add_argument("--g1-cells", type=int, default=16)
+    ap.add_argument("--bin-size", type=int, default=5_000_000,
+                    help="smoke default: a coarse ~620-bin genome keeps "
+                         "the three runs CI-cheap; drop to 500000 for "
+                         "the bench-shaped chaos run")
+    ap.add_argument("--kill-at", default="step2/chunk#3",
+                    help="fault site of the injected preemption")
+    ap.add_argument("--workdir", default=None,
+                    help="checkpoint/telemetry scratch dir (default: a "
+                         "fresh temp dir)")
+    ap.add_argument("--out", default=None, help="JSON verdict path")
+    ap.add_argument("--report", default=None,
+                    help="write the resumed run's rendered markdown "
+                         "report here (the 'Resilience' section)")
+    args = ap.parse_args(argv)
+
+    force_cpu_backend()
+
+    import numpy as np
+
+    from scdna_replication_tools_tpu.obs.schema import validate_run
+    from scdna_replication_tools_tpu.utils import faults as faults_mod
+
+    work = pathlib.Path(args.workdir) if args.workdir \
+        else pathlib.Path(tempfile.mkdtemp(prefix="pert_chaos_"))
+    work.mkdir(parents=True, exist_ok=True)
+    ck = work / "ck"
+    shutil.rmtree(ck, ignore_errors=True)
+
+    df_s, df_g, _ = make_genome_workload(args.cells, args.g1_cells,
+                                         bin_size=args.bin_size, seed=0)
+
+    print(f"chaos_smoke: golden run ({args.cells} S cells)...",
+          file=sys.stderr)
+    tau_golden, _ = _infer(df_s, df_g, str(work / "golden.jsonl"))
+
+    print(f"chaos_smoke: killed run (preempt@{args.kill_at})...",
+          file=sys.stderr)
+    preempted = False
+    try:
+        _infer(df_s, df_g, str(work / "killed.jsonl"),
+               checkpoint_dir=str(ck), checkpoint_every=1,
+               faults=f"preempt@{args.kill_at}")
+    except faults_mod.SimulatedPreemption:
+        preempted = True
+    faults_mod.install(None)
+
+    print("chaos_smoke: resumed run (--resume auto)...", file=sys.stderr)
+    tau_resumed, _ = _infer(df_s, df_g, str(work / "resumed.jsonl"),
+                            checkpoint_dir=str(ck), checkpoint_every=1)
+
+    killed_events = [json.loads(line) for line in
+                     (work / "killed.jsonl").read_text().splitlines()]
+    resumed_events = [json.loads(line) for line in
+                      (work / "resumed.jsonl").read_text().splitlines()]
+
+    checks = {
+        "preemption_fired": preempted,
+        "killed_log_has_fault_event": any(
+            ev["event"] == "fault_injected" for ev in killed_events),
+        "killed_run_ended_error": (killed_events[-1]["event"] == "run_end"
+                                   and killed_events[-1]["status"]
+                                   == "error"),
+        "resumed_log_schema_valid": validate_run(work / "resumed.jsonl")
+        == [],
+        "resumed_log_has_resume_trail": any(
+            ev["event"] == "resume" for ev in resumed_events),
+        "resumed_schema_version_4": resumed_events[0].get(
+            "schema_version", 0) >= 4,
+        "tau_bit_exact_vs_golden": bool(
+            np.array_equal(tau_golden, tau_resumed)),
+    }
+    max_abs = float(np.max(np.abs(tau_golden - tau_resumed))) \
+        if len(tau_golden) == len(tau_resumed) else float("inf")
+
+    if args.report:
+        from tools.pert_report import render_report
+
+        report = render_report(work / "resumed.jsonl")
+        pathlib.Path(args.report).write_text(report + "\n")
+        checks["report_has_resilience_section"] = "## Resilience" in report
+
+    verdict = {
+        "metric": "chaos_smoke_kill_and_resume",
+        "kill_at": args.kill_at,
+        "cells": args.cells,
+        "checks": checks,
+        "tau_max_abs_delta": max_abs,
+        "ok": all(checks.values()),
+        "workdir": str(work),
+    }
+    print(json.dumps(verdict))
+    if args.out:
+        pathlib.Path(args.out).write_text(json.dumps(verdict, indent=1)
+                                          + "\n")
+    if not verdict["ok"]:
+        failing = [k for k, v in checks.items() if not v]
+        print(f"chaos_smoke: FAILED checks: {failing}", file=sys.stderr)
+        return 1
+    print("chaos_smoke: OK — kill-and-resume parity holds",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -138,7 +138,10 @@ def run(args):
                 cell_chunk=args.cell_chunk,
                 mirror_rescue=args.mirror_rescue,
                 compile_cache_dir=args.compile_cache,
-                telemetry_path=args.telemetry)
+                telemetry_path=args.telemetry,
+                checkpoint_dir=args.checkpoint_dir, resume=args.resume,
+                watchdog_compile_seconds=args.watchdog_compile,
+                watchdog_chunk_seconds=args.watchdog_chunk)
     if args.profile_dir:
         import dataclasses
         scrt.config = dataclasses.replace(scrt.config,
@@ -280,6 +283,21 @@ def main(argv=None):
                          "the JSON as run_log and feeds peak_hbm_bytes + "
                          "compile-cache hit/miss counts — render with "
                          "tools/pert_report.py")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="durable step + in-fit checkpoints (and the "
+                         "resume manifest); with --resume auto a killed "
+                         "battery stage continues instead of restarting")
+    ap.add_argument("--resume", default="auto",
+                    choices=["auto", "force", "off"],
+                    help="resume policy against --checkpoint-dir "
+                         "(PertConfig.resume)")
+    ap.add_argument("--watchdog-compile", type=float, default=None,
+                    help="compile deadline in seconds: converts a hung "
+                         "compile (dead tunnel) into a typed, resumable "
+                         "abort (PertConfig.watchdog_compile_seconds)")
+    ap.add_argument("--watchdog-chunk", type=float, default=None,
+                    help="fit-chunk deadline in seconds "
+                         "(PertConfig.watchdog_chunk_seconds)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--profile-dir", default=None)
     ap.add_argument("--out", default=None)

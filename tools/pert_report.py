@@ -255,6 +255,48 @@ def _decision_trail_section(control: list, agg: dict) -> list:
     return lines
 
 
+def _resilience_section(res: dict, schema_version) -> list:
+    """The durability trail (schema v4 ``fault_injected`` / ``retry`` /
+    ``degrade`` / ``resume`` events + checkpoint traffic): what went
+    wrong, what the recovery ladder did about it, and how the run's
+    state survived — the audit a chaos test or a post-mortem reads
+    first.  Placeholder on pre-v4 logs."""
+    lines = ["## Resilience", ""]
+    res = res or {}
+    events = (res.get("faults") or []) + (res.get("retries") or []) \
+        + (res.get("degrades") or []) + (res.get("resumes") or [])
+    if not events and not res.get("checkpoint_saves"):
+        if schema_version is not None and schema_version < 4:
+            return lines + ["_pre-v4 run log: no durability events in "
+                            "this schema version_", ""]
+        return lines + ["_clean run: no faults injected, no retries, "
+                        "no degradations, no resumes_", ""]
+    lines.append(f"- **checkpoints**: {res.get('checkpoint_saves', 0)} "
+                 f"saved, {res.get('checkpoint_loads', 0)} loaded")
+    for ev in res.get("resumes") or []:
+        verified = ("fingerprint verified"
+                    if ev.get("fingerprint_verified")
+                    else "fingerprint NOT verified")
+        frm = (f" from iteration {ev['from_iter']}"
+               if ev.get("from_iter") is not None else "")
+        lines.append(f"- **resume ({ev.get('step')})**: "
+                     f"{ev.get('action')}{frm} (mode "
+                     f"{ev.get('mode')}, {verified})")
+    for ev in res.get("faults") or []:
+        lines.append(f"- **fault injected**: `{ev.get('kind')}` at "
+                     f"`{ev.get('site')}` (hit {ev.get('hit')})")
+    for ev in res.get("retries") or []:
+        lines.append(f"- **retry**: `{ev.get('label')}` attempt "
+                     f"{ev.get('attempt')}/{ev.get('max_attempts')} "
+                     f"after {ev.get('delay_seconds')}s — "
+                     f"{ev.get('error') or ev.get('error_class')}")
+    for ev in res.get("degrades") or []:
+        lines.append(f"- **degrade ({ev.get('step') or '-'})**: "
+                     f"`{ev.get('action')}` — {ev.get('detail') or ''}")
+    lines.append("")
+    return lines
+
+
 def _rescue_section(rescues: list) -> list:
     lines = ["## Mirror rescue", ""]
     if not rescues:
@@ -299,6 +341,8 @@ def render_report(path) -> str:
                                    summary.get("cell_qc", []))
     lines += _decision_trail_section(summary.get("control_decisions", []),
                                      summary.get("controller", {}))
+    lines += _resilience_section(summary.get("resilience", {}),
+                                 summary.get("schema_version"))
     lines += _compile_section(summary["compile"])
     lines += _rescue_section(summary["rescues"])
     lines += _nan_section(summary["nan_aborts"])

@@ -10,5 +10,6 @@ from tools.pertlint.rules import (  # noqa: F401
     partition_spec,
     print_log,
     rng,
+    swallowed,
     tracer_branch,
 )

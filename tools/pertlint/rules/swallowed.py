@@ -1,0 +1,119 @@
+"""PL011: swallowed broad exception handlers in library modules.
+
+The durability layer (``infer/runner.py``'s retry/degradation ladder,
+``utils/faults.py``'s exception taxonomy) only works when failures are
+VISIBLE: a ``except Exception:`` block that neither re-raises nor
+reports turns a preemption, OOM or real bug into silent state
+corruption — the run "succeeds" with whatever half-state the handler
+left behind, and no RunLog event or log line ever says why the output
+is wrong.  The observability contract (OBSERVABILITY.md) allows
+deliberate best-effort swallows (telemetry must not take down a fit),
+but they must be *auditable*: re-raise, emit a RunLog event, or log
+through the package logger.
+
+Precision contract (what keeps this rule quiet on correct code):
+
+* only BROAD handlers fire: a bare ``except:``, ``except Exception:``,
+  ``except BaseException:``, or a tuple containing either name.
+  Narrow handlers (``except OSError:``) encode a considered decision
+  about a specific failure mode and are exempt;
+* a handler is NOT swallowed when its body (nested nodes included)
+  contains any of: a ``raise`` statement; a RunLog ``.emit(...)`` call
+  (same receiver heuristic as PL009 — names/attributes containing
+  ``log``, ``current()``, ``self`` inside a ``*Log*`` class); a call
+  through a logger (``logger.warning(...)``, ``logging.warning(...)``,
+  any receiver whose name contains ``log``); or ``warnings.warn(...)``;
+* deliberate silent swallows remain expressible with the standard
+  inline suppression (``# pertlint: disable=PL011``) carrying its why —
+  the point is that silence must be a visible, reviewed decision.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.pertlint.core import Finding, Rule, register
+from tools.pertlint.rules.event_kinds import _is_runlog_receiver
+
+_BROAD_NAMES = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True   # bare except:
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD_NAMES
+    if isinstance(t, ast.Attribute):
+        return t.attr in _BROAD_NAMES   # builtins.Exception etc.
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _BROAD_NAMES
+                   or isinstance(e, ast.Attribute)
+                   and e.attr in _BROAD_NAMES
+                   for e in t.elts)
+    return False
+
+
+def _receiver_mentions_log(func: ast.Attribute) -> bool:
+    """Is this attribute call routed through something log-shaped?
+    (``logger.warning``, ``logging.warning``, ``profiling.logger.x``)."""
+    value = func.value
+    if isinstance(value, ast.Name):
+        return "log" in value.id.lower()
+    if isinstance(value, ast.Attribute):
+        return "log" in value.attr.lower()
+    return False
+
+
+def _handles(handler: ast.ExceptHandler, ctx) -> bool:
+    """Does the handler body re-raise or report the exception?"""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            continue
+        if func.attr == "emit" \
+                and _is_runlog_receiver(func.value, node, ctx):
+            return True
+        if func.attr == "warn" and isinstance(func.value, ast.Name) \
+                and func.value.id == "warnings":
+            return True
+        if func.attr in ("debug", "info", "warning", "error",
+                         "exception", "critical", "log") \
+                and _receiver_mentions_log(func):
+            return True
+    return False
+
+
+@register
+class SwallowedException(Rule):
+    id = "PL011"
+    name = "swallowed-exception-in-library"
+    severity = "error"
+    description = ("bare except: / except Exception: block that neither "
+                   "re-raises nor reports (RunLog event, package logger, "
+                   "warnings.warn) — silent failure corrupts the "
+                   "durability layer's audit trail; report or re-raise, "
+                   "or suppress inline with the WHY")
+
+    def check(self, ctx) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node):
+                continue
+            if _handles(node, ctx):
+                continue
+            kind = ("bare except:" if node.type is None else
+                    f"except {ast.unparse(node.type)}:")
+            yield self.finding(
+                ctx, node,
+                f"{kind} swallows the exception without re-raising or "
+                f"reporting it (no raise, no RunLog .emit, no logger "
+                f"call, no warnings.warn) — a preemption/OOM/bug "
+                f"disappears here with no audit trail; report it, "
+                f"re-raise it, or suppress inline with the rationale")

@@ -74,6 +74,14 @@ run_one() {  # run_one <name> <tpu_field> <timeout_s> <cmd...>
     return 0
 }
 
+# Durable stages: every full-pipeline stage runs with per-stage
+# checkpoints + `--resume auto`, so a tunnel drop mid-stage costs only
+# the in-flight fit chunk — the next window continues the battery
+# mid-budget instead of restarting the whole stage (and the per-stage
+# `timeout` plus the in-process watchdogs convert hangs into typed,
+# resumable aborts instead of rc=124 with nothing written).
+DURABLE="--resume auto --watchdog-compile 600 --watchdog-chunk 600"
+
 battery() {  # returns 0 only if every step it attempted succeeded
     # --budget full: keep the production-shaped sizes on TPU (bench.py
     # defaults to --budget fast so the bare harness invocation can't
@@ -84,14 +92,17 @@ battery() {  # returns 0 only if every step it attempted succeeded
         python bench.py --platform tpu --budget full --cells 10000 --iters 50 --skip-baseline || return 1
     run_one FULL_PIPELINE_r06_rescue_tpu platform 1500 \
         python tools/full_pipeline_bench.py --run-step3 --mirror-rescue \
+            --checkpoint-dir artifacts/ckpt_r06_rescue $DURABLE \
             --out artifacts/FULL_PIPELINE_r06_rescue_tpu.json || return 1
     run_one FULL_PIPELINE_r06_5k_tpu platform 3600 \
         python tools/full_pipeline_bench.py --cells 5000 --g1-cells 500 \
             --run-step3 --mirror-rescue \
+            --checkpoint-dir artifacts/ckpt_r06_5k $DURABLE \
             --out artifacts/FULL_PIPELINE_r06_5k_tpu.json || return 1
     run_one FULL_PIPELINE_r06_20kb_tpu platform 2400 \
         python tools/full_pipeline_bench.py --cells 250 --g1-cells 60 \
             --bin-size 20000 --run-step3 --mirror-rescue \
+            --checkpoint-dir artifacts/ckpt_r06_20kb $DURABLE \
             --out artifacts/FULL_PIPELINE_r06_20kb_tpu.json || return 1
     if [ ! -s artifacts/FULL_PIPELINE_r06_10k_tpu.json ] \
             && [ "$tries_10k" -lt "$MAX_10K_TRIES" ]; then
@@ -99,6 +110,7 @@ battery() {  # returns 0 only if every step it attempted succeeded
         run_one FULL_PIPELINE_r06_10k_tpu platform 7200 \
             python tools/full_pipeline_bench.py --cells 10000 --g1-cells 1000 \
                 --run-step3 --mirror-rescue --cell-chunk 2500 \
+                --checkpoint-dir artifacts/ckpt_r06_10k $DURABLE \
                 --out artifacts/FULL_PIPELINE_r06_10k_tpu.json || return 1
     fi
     return 0
