@@ -363,6 +363,14 @@ def _parse_args(argv=None):
                          "run log adds the run_start topology envelope "
                          "and a bench_result event for fleet-wide "
                          "collection)")
+    ap.add_argument("--metrics-textfile", default=None,
+                    help="also export the bench headline "
+                         "(pert_bench_cells_per_second) as a Prometheus "
+                         "textfile via the obs.metrics registry — the "
+                         "same scrape surface the pipeline's "
+                         "--metrics-textfile writes (the full per-run "
+                         "counter set comes from pipeline runs, not the "
+                         "microbench)")
     ap.add_argument("--fallback-reason", default=None,
                     help=argparse.SUPPRESS)  # set by the re-exec path only
     # --- adaptive-controller A/B (full pipeline, not the microbench) ---
@@ -487,6 +495,18 @@ def _run(args, platform, probe_attempts=None):
     # gate on this field instead
     import jax
     device_platform = jax.devices()[0].platform
+
+    from scdna_replication_tools_tpu.obs import metrics as metrics_mod
+
+    if getattr(args, "metrics_textfile", None):
+        # bench-local registry: the microbench has no runner, so the
+        # scrape surface is just the headline gauge (the JSON line
+        # stays the artifact of record)
+        registry = metrics_mod.MetricsRegistry.create(
+            textfile_path=args.metrics_textfile)
+        registry.gauge("pert_bench_cells_per_second").set(
+            round(cells_per_sec, 1))
+        registry.write_textfile()
 
     result = {
         "metric": "pert_step2_svi_cells_per_sec",
@@ -773,6 +793,8 @@ def main():
             # the failure runs are exactly the ones whose telemetry
             # matters — forward the flag or the promised JSONL vanishes
             argv += ["--telemetry", args.telemetry]
+        if getattr(args, "metrics_textfile", None):
+            argv += ["--metrics-textfile", args.metrics_textfile]
         out = subprocess.run(argv, env=env)
         sys.exit(out.returncode)
 

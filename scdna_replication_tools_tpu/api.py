@@ -29,6 +29,38 @@ from scdna_replication_tools_tpu.pipeline.consensus import (
 )
 
 
+def _feed_trace_scope_gauges(profile_dir, registry) -> None:
+    """Parse the run's jax.profiler traces and set one
+    ``pert_xla_scope_seconds`` gauge per ``pert/*`` named scope.
+
+    Best-effort by contract: the parser is ``tools/trace_summary.py``
+    (present in repo checkouts, not in wheel installs), and a missing
+    tools package or an empty/unreadable trace directory must degrade
+    to absent gauges, never to a failed run.
+    """
+    import pathlib
+    import sys
+
+    try:
+        try:
+            from tools.trace_summary import scope_totals
+        except ImportError:
+            # repo checkout driven from another cwd: tools/ sits next
+            # to the package directory
+            root = str(pathlib.Path(__file__).resolve().parents[1])
+            if root not in sys.path:
+                sys.path.insert(0, root)
+            from tools.trace_summary import scope_totals
+        for scope, seconds in scope_totals(str(profile_dir)).items():
+            registry.gauge("pert_xla_scope_seconds",
+                           labels={"scope": scope}).set(round(seconds, 6))
+    except Exception as exc:  # noqa: BLE001 — metrics enrichment must
+        # not take down the run it decorates
+        from scdna_replication_tools_tpu.utils.profiling import logger
+
+        logger.debug("metrics: trace-scope gauges unavailable (%s)", exc)
+
+
 class scRT:
     """Single-cell replication-timing inference facade.
 
@@ -45,7 +77,12 @@ class scRT:
     ``watchdog_chunk_seconds`` (per-phase hang deadlines);
     ``telemetry_path`` (structured JSONL run log, 'auto' = repo-local
     ``.pert_runs/``; the written path is surfaced as
-    ``scRT.run_log_path`` — see OBSERVABILITY.md) with
+    ``scRT.run_log_path`` — see OBSERVABILITY.md);
+    ``metrics_textfile`` (optional Prometheus text-exposition export of
+    the run's typed metrics registry, rewritten atomically at every
+    phase boundary — the registry itself always runs and emits
+    ``metrics_snapshot`` RunLog events; see OBSERVABILITY.md "Metrics &
+    the fleet index" and ``tools/pert_fleet.py``) with
     ``fit_diag_every`` controlling the in-fit diagnostics sampling
     stride; ``qc`` (default True) enables the model-health layer —
     posterior-confidence maps, convergence doctor, posterior-predictive
@@ -85,7 +122,7 @@ class scRT:
                  enum_impl='auto', cn_hmm_self_prob=None,
                  rho_from_rt_prior=False, mirror_rescue=True,
                  compile_cache_dir='auto', telemetry_path='auto',
-                 fit_diag_every=25,
+                 metrics_textfile=None, fit_diag_every=25,
                  qc=True, qc_entropy_thresh=0.5, qc_frac_thresh=0.25,
                  qc_ppc_replicates=8, qc_ppc_z=5.0,
                  controller=True, controller_max_extra_iters=None,
@@ -128,6 +165,7 @@ class scRT:
             mirror_rescue=mirror_rescue,
             compile_cache_dir=compile_cache_dir,
             telemetry_path=telemetry_path,
+            metrics_textfile=metrics_textfile,
             fit_diag_every=fit_diag_every,
             qc=qc, qc_entropy_thresh=qc_entropy_thresh,
             qc_frac_thresh=qc_frac_thresh,
@@ -143,6 +181,9 @@ class scRT:
         self.phase_report = None         # set by infer(level='pert'):
         # {phase: seconds} wall-clock ledger of the whole run (clone prep,
         # load, per-step build/h2d/trace/compile/fit, decode, packaging)
+        self.metrics_registry = None     # set by infer(level='pert'):
+        # the run's obs.metrics.MetricsRegistry (snapshot()/
+        # to_prometheus_text() for programmatic access after the run)
         self.run_log_path = None         # set by infer(level='pert'):
         # the structured JSONL telemetry artifact of the run (None when
         # telemetry_path disables it); render/compare with
@@ -198,6 +239,7 @@ class scRT:
     # -- PERT (reference: infer_scRT.py:127-168) --------------------------
 
     def infer_pert_model(self):
+        from scdna_replication_tools_tpu.obs import metrics as metrics_mod
         from scdna_replication_tools_tpu.obs.runlog import RunLog
         from scdna_replication_tools_tpu.utils.profiling import PhaseTimer
 
@@ -207,10 +249,20 @@ class scRT:
         # decode/packaging (the runner's own session wrapper defers to
         # an already-open log); run_end is guaranteed even on exception.
         # Creation is itself a measured phase (path probe + device
-        # queries are real milliseconds the >=95%-coverage invariant
-        # must account for)
+        # queries + the metrics-manifest read are real milliseconds the
+        # >=95%-coverage invariant must account for).  The registry is
+        # installed BEFORE the session opens so the early phases
+        # (clone_prep, load) and the run_start event are counted too;
+        # the facade's timer gets the metrics sink (chained with the
+        # RunLog's session sink)
         with timer.phase("telemetry/create"):
+            registry = metrics_mod.MetricsRegistry.create(
+                textfile_path=self.config.metrics_textfile)
+            metrics_mod.install(registry)
+            metrics_mod.attach_phase_sink(timer)
+            self.metrics_registry = registry
             run_log = RunLog.create(self.config.telemetry_path)
+        run_log.metrics_registry = registry
         self.run_log_path = run_log.path
         with run_log.session(config=self.config, timer=timer):
             with timer.phase("clone_prep"):
@@ -242,6 +294,7 @@ class scRT:
                     clone_idx_g1=_clone_idx(self.cn_g1, g1_data.cell_ids),
                     num_clones=len(clone_ids),
                     run_log=run_log,
+                    metrics=registry,
                 )
             # the runner accumulates its per-step phases into the same
             # ledger
@@ -284,7 +337,26 @@ class scRT:
             else:
                 cn_g1_out, supp_g1_out = None, None
 
+            if self.config.profile_dir:
+                # XLA named-scope device time as registry gauges, so it
+                # rides the final run_end metrics_snapshot (the traces
+                # were written when the per-step profiler contexts
+                # closed).  Best-effort: the parser lives in tools/
+                # (repo checkouts only) and a missing/empty trace dir
+                # must not fail the run it profiles.
+                with timer.phase("metrics/trace_scopes"):
+                    _feed_trace_scope_gauges(self.config.profile_dir,
+                                             registry)
+
         self.phase_report = timer.report()
+        # telemetry-off runs have no run_end snapshot; the scrape
+        # surface still gets its final (atomic) refresh.  The registry
+        # is then retired from the process-global seam (the object
+        # stays inspectable as scRT.metrics_registry); on an exception
+        # it stays installed until the next run replaces it — counters
+        # of a crashed run remain readable for the post-mortem
+        registry.write_textfile()
+        metrics_mod.uninstall(registry)
         return cn_s_out, supp_s_out, cn_g1_out, supp_g1_out
 
     def cell_qc(self) -> pd.DataFrame:

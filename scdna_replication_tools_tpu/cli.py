@@ -79,6 +79,14 @@ def infer_scrt_main(argv=None):
                         "a file/directory path, or 'none' to disable "
                         "(PertConfig.telemetry_path); render with "
                         "tools/pert_report.py")
+    p.add_argument("--metrics-textfile", default=None,
+                   help="Prometheus text-exposition export of the run's "
+                        "typed metrics registry, rewritten atomically at "
+                        "every phase boundary for scrape/node-exporter "
+                        "setups (PertConfig.metrics_textfile); the "
+                        "metrics_snapshot events in the run log and the "
+                        "fleet index (python -m tools.pert_fleet) work "
+                        "without it")
     p.add_argument("--qc", action=BooleanOptionalAction, default=True,
                    help="model-health QC: posterior-confidence maps, "
                         "convergence doctor, posterior-predictive checks "
@@ -128,6 +136,7 @@ def infer_scrt_main(argv=None):
                 mirror_rescue=args.mirror_rescue,
                 compile_cache_dir=args.compile_cache,
                 telemetry_path=args.telemetry,
+                metrics_textfile=args.metrics_textfile,
                 qc=args.qc, qc_entropy_thresh=args.qc_entropy_thresh,
                 qc_ppc_z=args.qc_ppc_z,
                 controller=args.controller,

@@ -162,6 +162,15 @@ class PertConfig:
     # processes no-op.  Render/compare with tools/pert_report.py; event
     # reference in OBSERVABILITY.md.
     telemetry_path: Optional[str] = "auto"
+    # Prometheus text-exposition export of the run's metrics registry
+    # (obs/metrics.py): each phase-boundary metrics_snapshot also
+    # rewrites this file ATOMICALLY (write-temp + os.replace), so a
+    # node-exporter textfile collector / scrape setup can watch a run
+    # in flight — the resident surface the future serving worker will
+    # reuse.  None (default) disables the file; the metrics_snapshot
+    # RunLog events and the fleet index (tools/pert_fleet.py) work
+    # either way.  Excluded from the config hash like telemetry_path.
+    metrics_textfile: Optional[str] = None
     # in-fit diagnostics sampling stride (infer/svi.py ring buffer):
     # every K iterations the compiled loop records loss + global
     # grad/param norms on device (no host sync; last 64 samples kept,

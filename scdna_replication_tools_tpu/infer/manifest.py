@@ -32,13 +32,17 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-import pathlib
-import tempfile
 import time
 from typing import Optional, Tuple
 
 import numpy as np
 
+from scdna_replication_tools_tpu.utils.fileio import (  # noqa: F401 —
+    # re-export: checkpoint.py (and historical callers) import the
+    # atomic-commit primitive from here; the one implementation now
+    # lives in utils/fileio.py, shared with the metrics textfile writer
+    atomic_write_bytes,
+)
 from scdna_replication_tools_tpu.utils.profiling import logger
 
 MANIFEST_VERSION = 1
@@ -49,28 +53,6 @@ MANIFEST_NAME = "manifest.json"
 # a deterministic stride of <= _FP_SAMPLES elements + the exact total
 # sum catches every realistic corruption/swap while staying O(ms)
 _FP_SAMPLES = 65536
-
-
-def atomic_write_bytes(path, data: bytes) -> None:
-    """Commit ``data`` to ``path`` atomically: temp file in the SAME
-    directory (os.replace across filesystems is not atomic), fsync,
-    replace.  A reader never observes a partial file."""
-    path = pathlib.Path(path)
-    path.parent.mkdir(parents=True, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=str(path.parent),
-                               prefix=path.name + ".", suffix=".tmp")
-    try:
-        with os.fdopen(fd, "wb") as fh:
-            fh.write(data)
-            fh.flush()
-            os.fsync(fh.fileno())
-        os.replace(tmp, str(path))
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
 
 
 def data_fingerprint(*arrays, samples: int = _FP_SAMPLES) -> str:

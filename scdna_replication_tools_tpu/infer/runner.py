@@ -54,6 +54,7 @@ from scdna_replication_tools_tpu.models.pert import (
     pert_loss,
     ppc_discrepancy,
 )
+from scdna_replication_tools_tpu.obs import metrics as metrics_mod
 from scdna_replication_tools_tpu.obs.controller import ControllerPolicy
 from scdna_replication_tools_tpu.ops.gc import gc_features
 from scdna_replication_tools_tpu.ops.stats import guess_times, pearson_matrix
@@ -152,6 +153,7 @@ class PertInference:
         clone_idx_g1: Optional[np.ndarray] = None,
         num_clones: int = 0,
         run_log: Optional[RunLog] = None,
+        metrics: Optional[metrics_mod.MetricsRegistry] = None,
     ):
         if config.resume not in ("auto", "force", "off"):
             # validate BEFORE any manifest mutation below: a typo'd
@@ -181,6 +183,20 @@ class PertInference:
         # directly-driven runner creates its own from the config
         self.run_log = run_log if run_log is not None \
             else RunLog.create(config.telemetry_path)
+        # typed metrics registry (obs/metrics.py): fed by the RunLog
+        # emit seam + the PhaseTimer sink, exported as metrics_snapshot
+        # events at step boundaries (+ a final one at run_end) and,
+        # when configured, an atomically-rewritten Prometheus textfile.
+        # Installed process-wide like the fault plan — the newest
+        # runner's registry wins, so counters never leak across runs
+        self._owns_metrics = metrics is None
+        self.metrics = metrics if metrics is not None \
+            else metrics_mod.MetricsRegistry.create(
+                textfile_path=config.metrics_textfile)
+        metrics_mod.install(self.metrics)
+        metrics_mod.attach_phase_sink(self.phases)
+        # the log's final run_end snapshot comes from THIS registry
+        self.run_log.metrics_registry = self.metrics
         # persistent XLA compilation cache (no-op when already configured
         # or disabled): repeated runs skip the per-step-program compiles
         self.compile_cache_dir = profiling.enable_persistent_compile_cache(
@@ -577,6 +593,9 @@ class PertInference:
         # phase-boundary injection site: a preemption here models the
         # classic kill-between-steps window
         faults_mod.point(f"{step_name}/start")
+        # HBM high-water before the step's programs run, so the
+        # per-phase delta in the snapshots is attributable to the step
+        metrics_mod.current().sample_device_memory()
         if self._manifest is not None:
             self._manifest.update_step(
                 step_name, "in_flight",
@@ -697,6 +716,13 @@ class PertInference:
         # phase-boundary injection site: the step's outputs are durably
         # committed — a preemption here must resume at the NEXT step
         faults_mod.point(f"{step_name}/end")
+        # phase-boundary metrics export: device-memory sample +
+        # metrics_snapshot event + atomic textfile refresh.  Accounted
+        # as its own phase — the >=95%-coverage invariant must absorb
+        # the export cost, however small
+        with self.phases.phase(f"{step_name}/metrics"):
+            metrics_mod.current().emit_snapshot(self.run_log,
+                                                f"{step_name}/end")
         return StepOutput(fit, spec, fixed, batch, wall)
 
     @staticmethod
@@ -1351,17 +1377,29 @@ class PertInference:
         and the facade's ``run_end`` (which also covers decode/packaging)
         is the one that closes the file.
         """
-        with self.run_log.session(config=self.config, timer=self.phases):
-            step1 = self.run_step1()
-            # timed separately from step2/build: at genome scale the CN
-            # prior (g1_composite / pearson_matrix over a (cells, loci, P)
-            # tensor) is its own multi-second stage (step 3's twin is
-            # timed inside step3/build because it happens there)
-            with self.phases.phase("step2/prior"):
-                etas = self.build_etas()
-            step2 = self.run_step2(step1, etas)
-            step3 = self.run_step3(step1, step2) \
-                if self.config.run_step3 else None
+        try:
+            with self.run_log.session(config=self.config,
+                                      timer=self.phases):
+                step1 = self.run_step1()
+                # timed separately from step2/build: at genome scale the
+                # CN prior (g1_composite / pearson_matrix over a
+                # (cells, loci, P) tensor) is its own multi-second stage
+                # (step 3's twin is timed inside step3/build because it
+                # happens there)
+                with self.phases.phase("step2/prior"):
+                    etas = self.build_etas()
+                step2 = self.run_step2(step1, etas)
+                step3 = self.run_step3(step1, step2) \
+                    if self.config.run_step3 else None
+            # telemetry-disabled runs get no run_end (and so no final
+            # snapshot event) — the textfile export must still land
+            self.metrics.write_textfile()
+        finally:
+            # a directly-driven runner owns its registry's lifetime; a
+            # facade-owned registry outlives the runner (packaging and
+            # the facade's own run_end still feed it)
+            if self._owns_metrics:
+                metrics_mod.uninstall(self.metrics)
         return step1, step2, step3
 
 

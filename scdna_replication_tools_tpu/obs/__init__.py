@@ -22,7 +22,12 @@ logger lines.  This package turns every run into a diffable artifact:
 * :mod:`~scdna_replication_tools_tpu.obs.doctor` — the convergence
   doctor: classifies each fit's loss tail (converged / plateaued /
   oscillating / diverging) plus gradient-norm health, surfaced as
-  ``FitResult.verdict`` and the ``fit_health`` event.
+  ``FitResult.verdict`` and the ``fit_health`` event;
+* :mod:`~scdna_replication_tools_tpu.obs.metrics` — the typed metrics
+  registry (counters / gauges / fixed-bucket histograms, catalogue in
+  ``metrics_manifest.json``): byte-stable ``metrics_snapshot`` events
+  at phase boundaries, an atomic Prometheus textfile, and the feed of
+  the cross-run fleet index (``tools/pert_fleet.py``).
 
 See OBSERVABILITY.md at the repo root for the event reference and how
 the JSONL relates to PhaseTimer and ``tools/trace_summary.py``.
@@ -40,6 +45,11 @@ from scdna_replication_tools_tpu.obs.doctor import (  # noqa: F401
     classify_loss_tail,
     diagnose_fit,
     tail_stats,
+)
+from scdna_replication_tools_tpu.obs.metrics import (  # noqa: F401
+    MetricsRegistry,
+    attach_phase_sink,
+    manifest_metrics,
 )
 from scdna_replication_tools_tpu.obs.runlog import (  # noqa: F401
     RunLog,

@@ -1,0 +1,616 @@
+"""Deterministic typed metrics registry: counters, gauges, fixed-bucket
+histograms.
+
+The RunLog (obs/runlog.py) records *what happened* as an event stream;
+this module turns the same signals into *quantities the repo can
+trend*: a per-run registry whose snapshot is byte-stable across
+same-seed reruns, exported three ways —
+
+* a ``metrics_snapshot`` RunLog event at phase boundaries plus a final
+  one guaranteed at ``run_end`` (schema v5), carrying only metrics the
+  checked-in manifest marks ``stable`` (wall-clock quantities would
+  break byte-determinism; they live in the textfile instead);
+* an optional Prometheus text-exposition file
+  (``PertConfig.metrics_textfile``), written atomically on every
+  snapshot — the resident surface a scrape/node-exporter setup (and
+  the future serving worker) reads;
+* the cross-run fleet index (``tools/pert_fleet.py``), which ingests
+  the snapshots (and derives timing metrics from standard RunLog
+  events, so pre-v5 logs trend too) into trends and CI regression
+  gates.
+
+Every metric name, type, label set and histogram bucket edge is pinned
+by the checked-in manifest (``obs/metrics_manifest.json``) — bucket
+edges in code would let snapshots drift across versions, and unlisted
+names are exactly how a fleet index fills with unqueryable one-offs
+(pertlint PL012 cross-checks literal names at call sites statically;
+the registry warns once per unknown name at runtime and still records,
+so a forgotten manifest entry degrades to a warning, not data loss).
+
+Like the RunLog's :func:`obs.runlog.current` and the fault plan's
+``install``, the active registry is a process-global seam
+(:func:`install` / :func:`current`): instrumented layers — the
+RunLog's emit hook, the PhaseTimer sink, ``tools/trace_summary`` —
+resolve it at call time and no-op against the null registry when no
+run is active.  Recording never raises: telemetry must not take down
+the fit it measures.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import math
+import os
+import pathlib
+from typing import Dict, List, Optional, Tuple
+
+from scdna_replication_tools_tpu.utils.fileio import atomic_write_bytes
+from scdna_replication_tools_tpu.utils.profiling import logger
+
+_MANIFEST_PATH = pathlib.Path(__file__).parent / "metrics_manifest.json"
+
+# bucket edges for histograms the manifest does not declare (unknown
+# metrics still record; their snapshots are as stable as these edges)
+_DEFAULT_BUCKETS = (0.01, 0.1, 1.0, 10.0, 60.0, 600.0)
+
+
+@functools.lru_cache(maxsize=1)
+def load_manifest() -> dict:
+    """The checked-in metric catalogue; {} when unreadable (the registry
+    then treats every name as unknown — a warning, never a crash)."""
+    try:
+        return json.loads(_MANIFEST_PATH.read_text())
+    except (OSError, ValueError):
+        return {}
+
+
+def manifest_metrics() -> dict:
+    """``name -> spec`` dict from the manifest ({} when unreadable)."""
+    return load_manifest().get("metrics", {})
+
+
+def metric_base_name(series_key: str) -> str:
+    """Manifest name of a flat series key: strip labels and the
+    histogram ``_count`` suffix (``flatten_snapshot`` emits those)."""
+    name = series_key.split("{", 1)[0]
+    if name.endswith("_count") and name[:-6] in manifest_metrics():
+        return name[:-6]
+    return name
+
+
+# a direction='higher' metric that cannot go negative can drop at most
+# 100% — its "bad" movement saturates at 1.0, so any (scaled) threshold
+# >= 1 would be mathematically unsatisfiable and the gate could never
+# fire.  Effective 'higher' thresholds are capped below that ceiling.
+_HIGHER_THRESHOLD_CAP = 0.95
+
+
+def regress_verdict(spec: Optional[dict], base, run,
+                    tolerance_scale: float = 1.0):
+    """The ONE per-metric regression judgement, shared by
+    ``tools/pert_fleet.py`` (the CI gate) and ``tools/pert_report.py
+    --compare`` (the run-pair diff) — two re-implementations of this
+    vocabulary would drift.
+
+    Returns ``(rel_delta, effective_threshold, verdict)`` with verdict
+    one of:
+
+    * ``REGRESSED`` — moved in the bad direction past the (scaled,
+      direction-capped) threshold; what gates fail on;
+    * ``improved`` / ``ok`` — moved the good way past it / within it;
+    * ``incomparable`` — the baseline is 0 and the run moved the bad
+      way: the relative delta is infinite and no tolerance scale could
+      pass it, so gating is undefined (callers surface a warning);
+    * ``untracked`` — the manifest arms no regress gate for the metric.
+
+    ``rel_delta`` is ``(run - base) / |base|`` (±inf from a zero base);
+    ``direction`` semantics come from the manifest entry: ``lower`` =
+    lower is better (an increase is bad), ``higher`` = higher is better
+    (a decrease is bad, with the effective threshold capped at 0.95 —
+    see ``_HIGHER_THRESHOLD_CAP`` — because a non-negative metric
+    cannot drop more than 100%).
+    """
+    if base != 0:
+        rel = (run - base) / abs(base)
+    else:
+        rel = float("inf") if run > 0 else (
+            float("-inf") if run < 0 else 0.0)
+    reg = (spec or {}).get("regress")
+    if not reg:
+        return rel, None, "untracked"
+    direction = reg.get("direction", "lower")
+    threshold = float(reg.get("threshold", 0.0)) * float(tolerance_scale)
+    if direction == "higher":
+        threshold = min(threshold, _HIGHER_THRESHOLD_CAP)
+    bad = rel if direction == "lower" else -rel
+    if base == 0 and bad > 0:
+        return rel, threshold, "incomparable"
+    if bad > threshold:
+        return rel, threshold, "REGRESSED"
+    if bad < -threshold:
+        return rel, threshold, "improved"
+    return rel, threshold, "ok"
+
+
+def _labels_key(labels: Optional[dict]) -> Tuple[Tuple[str, str], ...]:
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _series_name(name: str, lk: Tuple[Tuple[str, str], ...]) -> str:
+    """Canonical flat series key: ``name`` or ``name{k="v",...}`` with
+    label keys sorted — the same string in snapshots, the fleet index
+    and the Prometheus exposition, so every consumer joins on it."""
+    if not lk:
+        return name
+    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in lk)
+    return f"{name}{{{inner}}}"
+
+
+def _escape_label(value: str) -> str:
+    return (str(value).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _round6(value: float):
+    """Snapshot/exposition float policy: 6 decimals, ints stay ints —
+    repr drift (0.30000000000000004) must not break byte-stability."""
+    if isinstance(value, bool):
+        return int(value)
+    if isinstance(value, int):
+        return value
+    v = round(float(value), 6)
+    return int(v) if v == int(v) and abs(v) < 1e15 else v
+
+
+class _Series:
+    """One (name, labels) series: the handle ``counter()``/``gauge()``/
+    ``histogram()`` return."""
+
+    __slots__ = ("kind", "value", "buckets", "counts", "sum", "count")
+
+    def __init__(self, kind: str, buckets=None):
+        self.kind = kind
+        self.value = 0 if kind == "counter" else None
+        if kind == "histogram":
+            self.buckets = tuple(float(b) for b in (buckets
+                                                    or _DEFAULT_BUCKETS))
+            self.counts = [0] * (len(self.buckets) + 1)  # +1 = +Inf
+            self.sum = 0.0
+            self.count = 0
+
+    def inc(self, amount=1) -> None:
+        self.value = (self.value or 0) + amount
+
+    def set(self, value) -> None:
+        self.value = value
+
+    def set_max(self, value) -> None:
+        if self.value is None or value > self.value:
+            self.value = value
+
+    def observe(self, value) -> None:
+        value = float(value)
+        if math.isnan(value):
+            return
+        self.sum += value
+        self.count += 1
+        for i, edge in enumerate(self.buckets):
+            if value <= edge:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+
+class _NullSeries:
+    """Swallows every mutation — what the null registry hands out."""
+
+    value = None
+
+    def inc(self, amount=1) -> None:
+        pass
+
+    def set(self, value) -> None:
+        pass
+
+    def set_max(self, value) -> None:
+        pass
+
+    def observe(self, value) -> None:
+        pass
+
+
+_NULL_SERIES = _NullSeries()
+
+
+class MetricsRegistry:
+    """Per-run metrics registry (see module docstring).
+
+    Deterministic by construction: no timestamps, no randomness;
+    snapshot ordering is sorted series keys; floats are rounded to a
+    fixed precision.  ``textfile_path`` (optional) is where
+    :meth:`write_textfile` lands the Prometheus exposition.
+    """
+
+    enabled = True
+
+    def __init__(self, textfile_path: Optional[str] = None):
+        self.textfile_path = str(textfile_path) if textfile_path else None
+        self._series: Dict[Tuple[str, tuple], _Series] = {}
+        self._warned: set = set()
+        self._manifest = manifest_metrics()
+
+    @classmethod
+    def create(cls, textfile_path: Optional[str] = None
+               ) -> "MetricsRegistry":
+        return cls(textfile_path=textfile_path)
+
+    # -- series access ----------------------------------------------------
+
+    def _get(self, name: str, kind: str, labels: Optional[dict]):
+        spec = self._manifest.get(name)
+        if spec is None:
+            if name not in self._warned:
+                self._warned.add(name)
+                logger.warning(
+                    "metrics: %r is not in obs/metrics_manifest.json — "
+                    "recording anyway, but register it (name, type, "
+                    "labels, buckets) so snapshots, the fleet index and "
+                    "pertlint PL012 know about it", name)
+        elif spec.get("type") != kind and name not in self._warned:
+            self._warned.add(name)
+            logger.warning(
+                "metrics: %r is declared %r in the manifest but used as "
+                "%r at a call site", name, spec.get("type"), kind)
+        key = (name, _labels_key(labels))
+        series = self._series.get(key)
+        if series is None:
+            buckets = (spec or {}).get("buckets")
+            series = _Series(kind, buckets=buckets)
+            self._series[key] = series
+        return series
+
+    def counter(self, name: str, labels: Optional[dict] = None) -> _Series:
+        return self._get(name, "counter", labels)
+
+    def gauge(self, name: str, labels: Optional[dict] = None) -> _Series:
+        return self._get(name, "gauge", labels)
+
+    def histogram(self, name: str, labels: Optional[dict] = None
+                  ) -> _Series:
+        return self._get(name, "histogram", labels)
+
+    def observe(self, name: str, value, labels: Optional[dict] = None
+                ) -> None:
+        """Histogram shorthand: ``observe(name, v)``."""
+        self.histogram(name, labels=labels).observe(value)
+
+    def observe_phase(self, name: str, seconds: float) -> None:
+        """The PhaseTimer ``on_add`` sink target (see
+        :func:`attach_phase_sink`)."""
+        try:
+            self.counter("pert_phase_seconds_total",
+                         labels={"phase": name}).inc(float(seconds))
+        except Exception:  # pertlint: disable=PL011 — the sink rides
+            # inside PhaseTimer.add on every phase exit of every run; a
+            # malformed seconds value must cost nothing (and there is
+            # no failure here worth an audit line — the phase itself is
+            # still recorded by the timer and the RunLog)
+            pass
+
+    # -- instrumentation seams -------------------------------------------
+
+    def record_event(self, event: str, payload: dict) -> None:
+        """RunLog emit hook: map the event stream onto the catalogue.
+
+        Runs BEFORE the log's enable/session gating, so a telemetry-off
+        run still counts — metrics do not depend on the JSONL existing.
+        Never raises.
+        """
+        try:
+            self._record_event(event, payload)
+        except Exception as exc:  # noqa: BLE001 — a malformed payload
+            # must not break the emit path it rides on
+            logger.debug("metrics: record_event(%s) failed: %s", event,
+                         exc)
+
+    def _record_event(self, event: str, payload: dict) -> None:
+        self.counter("pert_runlog_events_total").inc()
+        if event == "compile":
+            cache = payload.get("cache")
+            if cache == "hit":
+                self.counter("pert_compile_cache_hits_total").inc()
+            elif cache == "miss":
+                self.counter("pert_compile_cache_misses_total").inc()
+                if payload.get("trace_seconds") is not None:
+                    self.observe("pert_trace_seconds",
+                                 payload["trace_seconds"])
+                if payload.get("compile_seconds") is not None:
+                    self.observe("pert_compile_seconds",
+                                 payload["compile_seconds"])
+            else:
+                self.counter("pert_compile_cache_uncacheable_total").inc()
+            if payload.get("peak_bytes"):
+                self.gauge("pert_program_peak_bytes").set_max(
+                    int(payload["peak_bytes"]))
+        elif event == "fit_end":
+            step = str(payload.get("step"))
+            seg = int(payload.get("iters") or 0) \
+                - int(payload.get("resumed_from_iter") or 0)
+            seg = max(seg, 0)
+            self.counter("pert_fit_iters_total",
+                         labels={"step": step}).inc(seg)
+            self.observe("pert_fit_iters", seg)
+            if payload.get("wall_seconds") is not None:
+                self.gauge("pert_fit_wall_seconds",
+                           labels={"step": step}).set(
+                    float(payload["wall_seconds"]))
+            if payload.get("iters_per_second") is not None:
+                self.gauge("pert_fit_iters_per_second",
+                           labels={"step": step}).set(
+                    float(payload["iters_per_second"]))
+        elif event == "control_decision":
+            action = payload.get("action")
+            if action:
+                self.counter("pert_controller_actions_total",
+                             labels={"action": str(action)}).inc()
+            if payload.get("iters_saved"):
+                self.counter("pert_controller_iters_saved_total").inc(
+                    int(payload["iters_saved"]))
+            if payload.get("iters_granted"):
+                self.counter("pert_controller_iters_granted_total").inc(
+                    int(payload["iters_granted"]))
+        elif event == "fault_injected":
+            self.counter("pert_faults_injected_total",
+                         labels={"kind": str(payload.get("kind"))}).inc()
+        elif event == "retry":
+            self.counter("pert_retries_total").inc()
+        elif event == "degrade":
+            self.counter("pert_degrades_total",
+                         labels={"action": str(payload.get("action"))}
+                         ).inc()
+        elif event == "checkpoint":
+            if payload.get("action") == "save":
+                self.counter("pert_checkpoint_saves_total").inc()
+            elif payload.get("action") == "load":
+                self.counter("pert_checkpoint_loads_total").inc()
+        elif event == "rescue":
+            self.counter("pert_rescue_candidates_total").inc(
+                int(payload.get("candidates") or 0))
+            self.counter("pert_rescue_accepted_total").inc(
+                int(payload.get("accepted") or 0))
+        elif event == "nan_abort":
+            self.counter("pert_nan_aborts_total").inc()
+
+    def sample_device_memory(self) -> None:
+        """Per-device HBM gauges from ``memory_stats()``; graceful no-op
+        where the backend lacks the stats (CPU) or jax is absent."""
+        try:
+            import jax
+
+            for dev in jax.local_devices():
+                stats_fn = getattr(dev, "memory_stats", None)
+                if stats_fn is None:
+                    continue
+                try:
+                    stats = stats_fn()
+                except Exception:  # pertlint: disable=PL011 — absence of
+                    # memory_stats on this backend IS the answer; the
+                    # gauge simply stays unset
+                    continue
+                if not stats:
+                    continue
+                label = {"device": str(getattr(dev, "id", "?"))}
+                peak = stats.get("peak_bytes_in_use")
+                if peak is not None:
+                    self.gauge("pert_device_hbm_peak_bytes",
+                               labels=label).set_max(int(peak))
+                in_use = stats.get("bytes_in_use")
+                if in_use is not None:
+                    self.gauge("pert_device_hbm_bytes_in_use",
+                               labels=label).set(int(in_use))
+        except Exception:  # pertlint: disable=PL011 — no jax backend
+            # means no devices to sample: nothing to report
+            pass
+
+    # -- export -----------------------------------------------------------
+
+    def _sorted_series(self) -> List[Tuple[str, str, _Series]]:
+        out = []
+        for (name, lk), series in self._series.items():
+            out.append((_series_name(name, lk), name, series))
+        return sorted(out, key=lambda t: t[0])
+
+    def snapshot(self, stable_only: bool = True) -> dict:
+        """``{series_key: payload}`` in sorted-key order.
+
+        ``stable_only`` (the ``metrics_snapshot`` event default) keeps
+        only metrics the manifest marks ``stable`` — the quantities that
+        are byte-identical across same-seed reruns — plus metrics whose
+        manifest entry sets ``"snapshot": "always"`` (opt-in diagnostic
+        surfaces like the XLA scope-time gauges: they exist only on
+        explicitly-profiled runs, which trade byte-stability for the
+        extra signal).  Unknown metrics count as unstable (nothing
+        vouches for them).  Counter/gauge payloads are ``{"type",
+        "value"}``; histograms carry per-bin ``buckets`` counts
+        (manifest edges + overflow), ``count`` and ``sum``.
+        """
+        snap: dict = {}
+        for key, name, series in self._sorted_series():
+            spec = self._manifest.get(name) or {}
+            if stable_only and not (spec.get("stable", False)
+                                    or spec.get("snapshot") == "always"):
+                continue
+            if series.kind == "histogram":
+                snap[key] = {"type": "histogram",
+                             "buckets": list(series.counts),
+                             "count": int(series.count),
+                             "sum": _round6(series.sum)}
+            else:
+                if series.value is None:
+                    continue
+                snap[key] = {"type": series.kind,
+                             "value": _round6(series.value)}
+        return snap
+
+    def to_prometheus_text(self) -> str:
+        """The full registry (stable + wall-clock metrics) in Prometheus
+        text exposition format, one HELP/TYPE block per metric name."""
+        by_name: Dict[str, List[Tuple[tuple, _Series]]] = {}
+        for (name, lk), series in self._series.items():
+            by_name.setdefault(name, []).append((lk, series))
+        lines: List[str] = []
+        for name in sorted(by_name):
+            spec = self._manifest.get(name, {})
+            help_text = str(spec.get("help", "")).replace("\\", r"\\") \
+                .replace("\n", r"\n")
+            kind = by_name[name][0][1].kind
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {kind}")
+            for lk, series in sorted(by_name[name], key=lambda t: t[0]):
+                if series.kind == "histogram":
+                    cum = 0
+                    for edge, count in zip(series.buckets, series.counts):
+                        cum += count
+                        lbl = lk + (("le", f"{edge:g}"),)
+                        lines.append(f"{_series_name(name + '_bucket', lbl)}"
+                                     f" {cum}")
+                    cum += series.counts[-1]
+                    lbl = lk + (("le", "+Inf"),)
+                    lines.append(f"{_series_name(name + '_bucket', lbl)} "
+                                 f"{cum}")
+                    lines.append(f"{_series_name(name + '_sum', lk)} "
+                                 f"{_round6(series.sum)}")
+                    lines.append(f"{_series_name(name + '_count', lk)} "
+                                 f"{series.count}")
+                elif series.value is not None:
+                    lines.append(f"{_series_name(name, lk)} "
+                                 f"{_round6(series.value)}")
+        return "\n".join(lines) + "\n"
+
+    def write_textfile(self, path: Optional[str] = None) -> Optional[str]:
+        """Atomically write the Prometheus exposition to ``path`` (or
+        the registry's configured ``textfile_path``).
+
+        Write-temp + ``os.replace`` in the destination directory, so a
+        concurrent scraper never reads a torn file — the node-exporter
+        textfile-collector contract.  Never raises; returns the path
+        written or None.
+        """
+        path = path or self.textfile_path
+        if not path:
+            return None
+        try:
+            path = os.path.abspath(path)
+            atomic_write_bytes(path, self.to_prometheus_text().encode())
+            return path
+        except OSError as exc:
+            if "textfile" not in self._warned:
+                self._warned.add("textfile")
+                logger.warning("metrics: cannot write textfile %s (%s)",
+                               path, exc)
+            return None
+
+    def emit_snapshot(self, run_log, phase: str) -> None:
+        """One phase-boundary export: sample device memory, emit the
+        ``metrics_snapshot`` event (stable metrics only — the event must
+        be byte-stable across same-seed reruns), refresh the textfile.
+        Never raises."""
+        try:
+            self.sample_device_memory()
+            run_log.emit("metrics_snapshot", phase=str(phase),
+                         metrics=self.snapshot())
+            self.write_textfile()
+        except Exception as exc:  # noqa: BLE001 — the export is
+            # best-effort by contract; the run it measures must proceed
+            logger.debug("metrics: snapshot at %s failed: %s", phase, exc)
+
+
+class _NullRegistry:
+    """Accepts every call as a no-op — :func:`current` outside a run."""
+
+    enabled = False
+    textfile_path = None
+
+    def counter(self, name, labels=None):
+        return _NULL_SERIES
+
+    gauge = counter
+    histogram = counter
+
+    def observe(self, name, value, labels=None):
+        pass
+
+    def observe_phase(self, name, seconds):
+        pass
+
+    def record_event(self, event, payload):
+        pass
+
+    def sample_device_memory(self):
+        pass
+
+    def snapshot(self, stable_only=True):
+        return {}
+
+    def to_prometheus_text(self):
+        return ""
+
+    def write_textfile(self, path=None):
+        return None
+
+    def emit_snapshot(self, run_log, phase):
+        pass
+
+
+_NULL = _NullRegistry()
+_ACTIVE: Optional[MetricsRegistry] = None
+
+
+def install(registry: Optional[MetricsRegistry]) -> None:
+    """Install (or clear, with None) the process-wide active registry.
+
+    Process-global on purpose, like :func:`obs.runlog.current` and the
+    fault plan: the instrumented layers (the RunLog emit hook, the
+    PhaseTimer sink, trace_summary) have no config plumbing.  The
+    newest runner's registry wins; tests install and clear per case.
+    """
+    global _ACTIVE
+    _ACTIVE = registry
+
+
+def uninstall(registry) -> None:
+    """Clear the active registry — but only if it is still ``registry``
+    (a newer run's install must not be clobbered by an older run's
+    cleanup)."""
+    global _ACTIVE
+    if _ACTIVE is registry:
+        _ACTIVE = None
+
+
+def current():
+    """The active registry, or the null no-op instance."""
+    return _ACTIVE if _ACTIVE is not None else _NULL
+
+
+def attach_phase_sink(timer) -> None:
+    """Chain a metrics sink onto ``timer.on_add`` (PhaseTimer).
+
+    The sink resolves :func:`current` at call time (so it can be
+    attached before any registry exists) and forwards to whatever sink
+    was already installed — co-existing with the RunLog's session sink
+    regardless of attach order.  Idempotent per timer.
+    """
+    prev = getattr(timer, "on_add", None)
+    if getattr(prev, "_pert_metrics_sink", False):
+        return
+
+    def _sink(name, seconds):
+        current().observe_phase(name, seconds)
+        if prev is not None:
+            prev(name, seconds)
+
+    _sink._pert_metrics_sink = True
+    timer.on_add = _sink

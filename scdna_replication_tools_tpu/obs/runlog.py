@@ -34,13 +34,15 @@ import pathlib
 import time
 from typing import Optional
 
+from scdna_replication_tools_tpu.obs import metrics as _metrics
 from scdna_replication_tools_tpu.utils import profiling
 from scdna_replication_tools_tpu.utils.profiling import logger
 
-SCHEMA_VERSION = 4  # v4: durability events (fault_injected, retry,
-# degrade, resume — the fault-tolerance layer's audit trail); v3 added
-# control_decision (adaptive fit controller); v2 the model-health
-# events (fit_health, cell_qc_summary)
+SCHEMA_VERSION = 5  # v5: metrics_snapshot (the typed metrics registry's
+# phase-boundary export, obs/metrics.py); v4 added durability events
+# (fault_injected, retry, degrade, resume — the fault-tolerance layer's
+# audit trail); v3 control_decision (adaptive fit controller); v2 the
+# model-health events (fit_health, cell_qc_summary)
 
 
 def _json_safe(value):
@@ -131,18 +133,19 @@ _RUN_COUNTER = itertools.count()
 def _config_digest(config) -> Optional[str]:
     """Short content hash of the config for run comparison.
 
-    ``telemetry_path`` is excluded: it names where THIS log lands (every
-    run's differs), and the hash's job is "same experiment?" — a
-    cold/warm or A/B pair must hash equal when only the log location
-    moved.  Fields that change behaviour (compile_cache_dir,
-    checkpoint_dir, iteration budgets, ...) stay in.
+    ``telemetry_path`` and ``metrics_textfile`` are excluded: they name
+    where THIS run's observability lands (every run's differs), and the
+    hash's job is "same experiment?" — a cold/warm or A/B pair must
+    hash equal when only the log/scrape locations moved.  Fields that
+    change behaviour (compile_cache_dir, checkpoint_dir, iteration
+    budgets, ...) stay in.
     """
     try:
         if dataclasses.is_dataclass(config):
             config = dataclasses.asdict(config)
         if isinstance(config, dict):
             config = {k: v for k, v in config.items()
-                      if k != "telemetry_path"}
+                      if k not in ("telemetry_path", "metrics_textfile")}
         blob = json.dumps(config, sort_keys=True, default=_json_safe)
         return hashlib.sha256(blob.encode()).hexdigest()[:12]
     except (TypeError, ValueError):
@@ -236,6 +239,12 @@ class RunLog:
         self._t0: Optional[float] = None
         self._open = False
         self._pending_context: dict = {}
+        # the metrics registry that OWNS this log's final snapshot (set
+        # by the runner/facade that created both): close_run emits the
+        # guaranteed run_end metrics_snapshot from it.  None for bare
+        # logs (bench runs, tests) — a stale process-global registry
+        # must never inject snapshot events into an unrelated stream
+        self.metrics_registry = None
 
     @classmethod
     def create(cls, telemetry_path, run_name: str = "pert") -> "RunLog":
@@ -326,6 +335,13 @@ class RunLog:
         # still needs its session state reset and its handle closed
         if not self._open:
             return
+        # the GUARANTEED final metrics snapshot: close_run is reached on
+        # every session exit (including the exception path), so a run
+        # whose log owns a metrics registry always closes with one
+        # phase='run_end' snapshot before run_end itself — and the
+        # snapshot's event rides inside the events_emitted count below
+        if self.metrics_registry is not None:
+            self.metrics_registry.emit_snapshot(self, "run_end")
         payload: dict = {"status": status,
                          "wall_seconds": round(self._elapsed(), 4),
                          "events_emitted": self._seq}
@@ -362,7 +378,17 @@ class RunLog:
         prev_sink = None
         if timer is not None:
             prev_sink = getattr(timer, "on_add", None)
-            timer.on_add = self._phase_sink
+
+            # CHAIN, don't replace: the metrics registry attaches its
+            # own on_add sink (obs.metrics.attach_phase_sink), and the
+            # session must not eat its phase stream for the run's
+            # duration — both sinks observe every accumulation
+            def _chained_sink(name, seconds, _prev=prev_sink):
+                self._phase_sink(name, seconds)
+                if _prev is not None:
+                    _prev(name, seconds)
+
+            timer.on_add = _chained_sink
             # opening the run (config digest, version/device queries,
             # the run_start write) is accounted wall — the coverage
             # invariant holds with telemetry on
@@ -401,6 +427,11 @@ class RunLog:
         must not reopen — and thereby truncate — the completed
         artifact (``run_end`` itself is written before ``_open``
         clears)."""
+        # the metrics seam: every emit — BEFORE the enable/session
+        # gating — feeds the active registry, so counters (fit iters,
+        # cache hits, degrades, faults...) accumulate even when the
+        # JSONL itself is disabled or the event would be dropped
+        _metrics.current().record_event(event, payload)
         if not self.enabled or not self._open:
             return
         record = {"event": event, "seq": self._seq,

@@ -33,6 +33,86 @@ def _of(events: List[dict], kind: str) -> List[dict]:
     return [ev for ev in events if ev.get("event") == kind]
 
 
+def _snapshot_hbm_max(snapshot: dict) -> Optional[int]:
+    """Max per-device HBM high-water gauge inside one metrics_snapshot
+    payload; None when the backend lacks memory_stats (e.g. CPU)."""
+    peaks = [entry.get("value") for key, entry in (snapshot or {}).items()
+             if key.startswith("pert_device_hbm_peak_bytes")
+             and isinstance(entry, dict)
+             and isinstance(entry.get("value"), (int, float))]
+    return int(max(peaks)) if peaks else None
+
+
+def flatten_snapshot(snapshot: dict) -> dict:
+    """One metrics_snapshot payload -> flat ``{series_key: scalar}``.
+
+    Counters/gauges contribute their value under the series key;
+    histograms contribute ``<key>_count`` (the observation count — the
+    scalar that trends; the bucket vector stays in the event).
+    """
+    flat: dict = {}
+    for key, entry in (snapshot or {}).items():
+        if not isinstance(entry, dict):
+            continue
+        if entry.get("type") == "histogram":
+            if entry.get("count") is not None:
+                flat[f"{key}_count"] = entry["count"]
+        elif entry.get("value") is not None:
+            flat[key] = entry["value"]
+    return flat
+
+
+def derived_metrics(summary: dict) -> dict:
+    """Manifest metrics computed from STANDARD RunLog events (the
+    ``source: derived:runlog`` entries in obs/metrics_manifest.json).
+
+    This is what lets the fleet index trend pre-v5 logs — wall, fit
+    wall, throughput, compile totals and the HBM high-water all predate
+    the registry — and it doubles as the home of the wall-clock
+    quantities the byte-stable snapshots deliberately exclude.
+    """
+    out: dict = {}
+    if summary.get("wall_seconds") is not None:
+        out["pert_wall_seconds"] = round(float(summary["wall_seconds"]), 4)
+    fits = summary.get("fits") or []
+    fit_wall = sum(float(f.get("wall_seconds") or 0.0) for f in fits)
+    fit_iters = sum(int(f.get("iters") or 0) for f in fits)
+    if fits:
+        out["pert_fit_wall_seconds"] = round(fit_wall, 4)
+        out["pert_fit_iters_total"] = fit_iters
+        if fit_wall > 0:
+            out["pert_iters_per_second"] = round(fit_iters / fit_wall, 2)
+    phases = summary.get("phases") or {}
+    if phases:
+        fitlike = sum(v for k, v in phases.items()
+                      if k.endswith("/fit") or k.endswith("/rescue"))
+        out["pert_non_fit_wall_seconds"] = round(
+            sum(phases.values()) - fitlike, 4)
+    comp = summary.get("compile") or {}
+    if comp.get("programs"):
+        out["pert_trace_compile_seconds"] = round(
+            float(comp.get("trace_seconds") or 0.0)
+            + float(comp.get("compile_seconds") or 0.0), 4)
+        out["pert_compile_cache_hits_total"] = comp.get("cache_hits", 0)
+        out["pert_compile_cache_misses_total"] = comp.get("cache_misses",
+                                                          0)
+    if comp.get("peak_bytes_max") is not None:
+        out["pert_peak_hbm_bytes"] = comp["peak_bytes_max"]
+    return out
+
+
+def flat_metrics(summary: dict) -> dict:
+    """The queryable per-run metric vector: event-derived metrics
+    overlaid with the final metrics_snapshot (registry values win where
+    both exist — they are the same quantity, measured at the source).
+    The shared extraction of ``tools/pert_fleet.py`` and
+    ``tools/pert_report.py --compare``.
+    """
+    metrics_info = summary.get("metrics") or {}
+    return {**derived_metrics(summary),
+            **flatten_snapshot(metrics_info.get("final") or {})}
+
+
 def summarize_events(events: List[dict]) -> dict:
     """Aggregate one run's events; every section is None/empty-safe so a
     partial (crashed) log still summarises."""
@@ -92,13 +172,26 @@ def summarize_events(events: List[dict]) -> dict:
         "nan_abort": ev.get("nan_abort"),
         "wall_seconds": ev.get("wall_seconds"),
         "iters_per_second": ev.get("iters_per_second"),
+        "num_cells": ev.get("num_cells"),
         "program_cache": ev.get("program_cache"),
         "diagnostics": ev.get("diagnostics"),
     } for ev in _of(events, "fit_end")]
 
+    # the typed-metrics export (schema v5): snapshot count, the FINAL
+    # (run_end) snapshot payload, and the per-phase HBM high-water trail
+    # — all None/empty on pre-v5 logs, so every consumer (pert_report's
+    # "Metrics" section, the fleet index) renders a placeholder then
+    snaps = _of(events, "metrics_snapshot")
+    hbm_by_phase = {}
+    for ev in snaps:
+        peak = _snapshot_hbm_max(ev.get("metrics") or {})
+        if peak is not None:
+            hbm_by_phase[str(ev.get("phase"))] = peak
+
     return {
         "run_name": start.get("run_name"),
         "schema_version": start.get("schema_version"),
+        "started_unix": start.get("started_unix"),
         "config_hash": start.get("config_hash"),
         "platform": start.get("platform"),
         "device_kind": start.get("device_kind"),
@@ -139,6 +232,11 @@ def summarize_events(events: List[dict]) -> dict:
             "actions": {a: sum(1 for d in control if d["action"] == a)
                         for a in sorted({d["action"] for d in control
                                          if d["action"]})},
+        },
+        "metrics": {
+            "snapshots": len(snaps),
+            "final": (snaps[-1].get("metrics") or None) if snaps else None,
+            "hbm_by_phase": hbm_by_phase,
         },
         "rescues": _of(events, "rescue"),
         "nan_aborts": _of(events, "nan_abort"),

@@ -1,0 +1,38 @@
+"""Crash-safe file primitives shared across layers.
+
+``atomic_write_bytes`` started life in ``infer/manifest.py`` (the
+durable-run manifest commit) and was then needed by the checkpoint
+writer and the metrics Prometheus-textfile export — three copies of
+the same subtle contract (same-directory temp file, fsync BEFORE
+replace, unlink on failure) would drift, so the one implementation
+lives here in ``utils/`` where every layer may import it without
+inverting the package layering (``obs`` must not depend on ``infer``).
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import tempfile
+
+
+def atomic_write_bytes(path, data: bytes) -> None:
+    """Commit ``data`` to ``path`` atomically: temp file in the SAME
+    directory (os.replace across filesystems is not atomic), fsync,
+    replace.  A reader never observes a partial file."""
+    path = pathlib.Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=str(path.parent),
+                               prefix=path.name + ".", suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, str(path))
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise

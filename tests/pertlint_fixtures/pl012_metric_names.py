@@ -1,0 +1,45 @@
+"""Fixture for PL012 (unknown-metric-name).
+
+Parsed by the lint tests, never imported.  Lines ending in the expect
+marker must fire; the inline-disable line must land in the suppressed
+list.  Known names come from the REAL checked-in manifest
+(obs/metrics_manifest.json) — 'pert_fit_iters_total',
+'pert_trace_seconds', 'pert_device_hbm_peak_bytes' are in it;
+'pert_fit_iterz_total' and 'my_adhoc_metric' are not.
+"""
+
+
+def known_names_are_clean(metrics, registry, metrics_mod):
+    metrics.counter("pert_fit_iters_total",
+                    labels={"step": "step2"}).inc(10)     # in manifest
+    registry.gauge("pert_device_hbm_peak_bytes",
+                   labels={"device": "0"}).set(1 << 30)   # in manifest
+    metrics_mod.current().observe("pert_trace_seconds", 1.5)  # current()
+
+
+def unknown_name_fires(metrics):
+    metrics.counter("pert_fit_iterz_total").inc()  # expect: PL012
+    metrics.histogram("my_adhoc_metric").observe(2)  # pertlint: disable=PL012
+
+
+def self_receiver_in_metrics_class_fires():
+    class FakeMetricsRegistry:
+        def counter(self, name, labels=None):
+            return self
+
+        def inc(self, amount=1):
+            return None
+
+        def record(self):
+            self.counter("pert_bogus_series_total").inc()  # expect: PL012
+
+
+def dynamic_name_is_exempt(metrics, name):
+    # non-literal: the runtime warn-once covers it
+    metrics.counter(name).inc()
+
+
+def non_registry_receivers_are_exempt(stream, watchdog):
+    # .observe on other APIs is a different vocabulary
+    stream.observe("next_value")
+    watchdog.observe("heartbeat")

@@ -45,6 +45,7 @@ FIXTURES = {
     "PL009": FIXTURE_DIR / "pl009_event_kinds.py",
     "PL010": FIXTURE_DIR / "pl010_control_actions.py",
     "PL011": FIXTURE_DIR / "pl011_swallowed.py",
+    "PL012": FIXTURE_DIR / "pl012_metric_names.py",
 }
 
 
@@ -194,6 +195,8 @@ def _seed_violation(rule_id):
                   "action='bogus_action', iter=1)\n"),
         "PL011": ("\ndef seeded(fn):\n    try:\n        return fn()\n"
                   "    except Exception:\n        return None\n"),
+        "PL012": ("\ndef seeded(metrics):\n"
+                  "    metrics.counter('pert_bogus_total').inc()\n"),
     }[rule_id]
 
 

@@ -139,6 +139,7 @@ def run(args):
                 mirror_rescue=args.mirror_rescue,
                 compile_cache_dir=args.compile_cache,
                 telemetry_path=args.telemetry,
+                metrics_textfile=args.metrics_textfile,
                 checkpoint_dir=args.checkpoint_dir, resume=args.resume,
                 watchdog_compile_seconds=args.watchdog_compile,
                 watchdog_chunk_seconds=args.watchdog_chunk)
@@ -180,10 +181,20 @@ def run(args):
     # events carry cost_analysis/memory_analysis per program); a
     # disabled run log leaves the fields null
     run_summary = None
+    fleet_metrics = None
     if scrt.run_log_path:
-        from scdna_replication_tools_tpu.obs.summary import summarize_run
+        from scdna_replication_tools_tpu.obs.summary import (
+            flat_metrics,
+            summarize_run,
+        )
 
         run_summary = summarize_run(scrt.run_log_path)
+        if run_summary is not None:
+            # the same flat per-run metric vector the fleet index
+            # (tools/pert_fleet.py) extracts — in the artifact itself,
+            # so a committed bench JSON is regression-comparable even
+            # without its run log
+            fleet_metrics = flat_metrics(run_summary)
     compile_info = (run_summary or {}).get("compile") or {}
 
     dev = jax.devices()[0]
@@ -195,6 +206,8 @@ def run(args):
         "non_fit_wall_seconds": round(non_fit, 2),
         "compile_cache": args.compile_cache,
         "run_log": scrt.run_log_path,
+        "metrics_textfile": args.metrics_textfile,
+        "fleet_metrics": fleet_metrics,
         "peak_hbm_bytes": compile_info.get("peak_bytes_max"),
         "compile_cache_hits": compile_info.get("cache_hits"),
         "compile_cache_misses": compile_info.get("cache_misses"),
@@ -283,6 +296,13 @@ def main(argv=None):
                          "the JSON as run_log and feeds peak_hbm_bytes + "
                          "compile-cache hit/miss counts — render with "
                          "tools/pert_report.py")
+    ap.add_argument("--metrics-textfile", default=None,
+                    help="Prometheus text-exposition export of the "
+                         "run's metrics registry, rewritten atomically "
+                         "at every phase boundary "
+                         "(PertConfig.metrics_textfile); the "
+                         "metrics_snapshot events in --telemetry and "
+                         "the fleet index work without it")
     ap.add_argument("--checkpoint-dir", default=None,
                     help="durable step + in-fit checkpoints (and the "
                          "resume manifest); with --resume auto a killed "

@@ -25,7 +25,13 @@ import sys
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
 
+from scdna_replication_tools_tpu.obs.metrics import (  # noqa: E402
+    manifest_metrics,
+    metric_base_name,
+    regress_verdict,
+)
 from scdna_replication_tools_tpu.obs.summary import (  # noqa: E402
+    flat_metrics,
     summarize_run,
 )
 
@@ -297,6 +303,51 @@ def _resilience_section(res: dict, schema_version) -> list:
     return lines
 
 
+def _fmt_metric_value(entry: dict) -> str:
+    if entry.get("type") == "histogram":
+        return (f"count={entry.get('count')} sum={entry.get('sum')} "
+                f"buckets={entry.get('buckets')}")
+    v = entry.get("value")
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def _metrics_section(metrics_info: dict, schema_version) -> list:
+    """The typed-metrics export (schema v5 ``metrics_snapshot`` events):
+    the final registry snapshot plus the per-phase device-memory
+    high-water trail.  Placeholder on pre-v5 logs."""
+    lines = ["## Metrics", ""]
+    metrics_info = metrics_info or {}
+    final = metrics_info.get("final")
+    if not final:
+        if schema_version is not None and schema_version < 5:
+            return lines + ["_pre-v5 run log: no metrics_snapshot "
+                            "events in this schema version_", ""]
+        return lines + ["_no metrics_snapshot events (no metrics "
+                        "registry was active)_", ""]
+    lines.append(f"- **snapshots**: {metrics_info.get('snapshots', 0)} "
+                 f"(the table below is the final, run_end snapshot; "
+                 f"wall-clock metrics live in the Prometheus textfile "
+                 f"— see obs/metrics_manifest.json)")
+    lines += ["", "| metric | type | value |", "|---|---|---|"]
+    for key in sorted(final):
+        entry = final[key]
+        if not isinstance(entry, dict):
+            continue
+        lines.append(f"| `{key}` | {entry.get('type')} "
+                     f"| {_fmt_metric_value(entry)} |")
+    hbm = metrics_info.get("hbm_by_phase") or {}
+    if hbm:
+        lines += ["", "per-phase device HBM high-water "
+                      "(max over local devices):", "",
+                  "| phase boundary | HBM high-water |", "|---|---:|"]
+        for phase, peak in hbm.items():
+            lines.append(f"| `{phase}` | {_fmt_bytes(peak)} |")
+    lines.append("")
+    return lines
+
+
 def _rescue_section(rescues: list) -> list:
     lines = ["## Mirror rescue", ""]
     if not rescues:
@@ -343,6 +394,8 @@ def render_report(path) -> str:
                                      summary.get("controller", {}))
     lines += _resilience_section(summary.get("resilience", {}),
                                  summary.get("schema_version"))
+    lines += _metrics_section(summary.get("metrics", {}),
+                              summary.get("schema_version"))
     lines += _compile_section(summary["compile"])
     lines += _rescue_section(summary["rescues"])
     lines += _nan_section(summary["nan_aborts"])
@@ -433,7 +486,45 @@ def render_compare(path_a, path_b) -> str:
         f"{_delta(ca['trace_seconds'] + ca['compile_seconds'], cb['trace_seconds'] + cb['compile_seconds'])}",
         "",
     ]
+    lines += _metrics_compare_section(sa, sb)
     return "\n".join(lines)
+
+
+def _metrics_compare_section(sa: dict, sb: dict) -> list:
+    """Per-metric deltas between two runs with the manifest's regression
+    thresholds applied — literally the same judgement as ``pert_fleet
+    regress`` (the shared ``obs.metrics.regress_verdict``), inline in a
+    run diff.  Uses the shared flat metric vector (final
+    metrics_snapshot + event-derived values), so pre-v5 logs still diff
+    on their derived metrics."""
+    ma, mb = flat_metrics(sa), flat_metrics(sb)
+    if not ma and not mb:
+        return ["## Metrics (B - A)", "", "_no metrics in either run_",
+                ""]
+    known = manifest_metrics()
+    lines = ["## Metrics (B - A)", "",
+             "| metric | A | B | Δ rel | threshold | verdict |",
+             "|---|---:|---:|---:|---:|---|"]
+    presentation = {"REGRESSED": "⚠ **over threshold**",
+                    "untracked": "-"}
+    for key in sorted(set(ma) | set(mb)):
+        va, vb = ma.get(key), mb.get(key)
+        rel = thr = None
+        verdict = "-"
+        if isinstance(va, (int, float)) and isinstance(vb, (int, float)):
+            rel, thr, verdict = regress_verdict(
+                known.get(metric_base_name(key)), va, vb)
+            verdict = presentation.get(verdict, verdict)
+        num = (lambda v: "-" if v is None
+               else (f"{v:.6g}" if isinstance(v, float) else str(v)))
+        rel_txt = "-" if rel is None or rel != rel \
+            or abs(rel) == float("inf") else f"{rel:+.1%}"
+        lines.append(
+            f"| `{key}` | {num(va)} | {num(vb)} "
+            f"| {rel_txt} "
+            f"| {'-' if thr is None else f'±{thr:.0%}'} | {verdict} |")
+    lines.append("")
+    return lines
 
 
 def main(argv=None):

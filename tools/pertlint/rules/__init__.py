@@ -7,6 +7,7 @@ from tools.pertlint.rules import (  # noqa: F401
     event_kinds,
     host_sync,
     jit_in_loop,
+    metric_names,
     partition_spec,
     print_log,
     rng,

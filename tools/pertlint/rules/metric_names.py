@@ -1,0 +1,133 @@
+"""PL012: registry metric names must exist in the metrics manifest.
+
+The metrics contract is the checked-in catalogue
+(``scdna_replication_tools_tpu/obs/metrics_manifest.json``): every
+metric the registry (obs/metrics.py) records is declared there — name,
+type, labels, histogram bucket edges, regression thresholds.  A call
+site recording an undeclared name still works at runtime (the registry
+warns once and records anyway, because losing data over a missing
+manifest row would be worse), but the metric is invisible to the
+snapshot (unknown = unstable), untrended by the fleet index and
+ungated by ``pert_fleet regress`` — a one-off that silently never
+becomes a quantity the repo can reason about.  This rule closes the
+gap statically, exactly like PL009/PL010 do for the RunLog event and
+action enums: every LITERAL metric name at a registry call site is
+cross-checked against the manifest at lint time.
+
+Precision contract (what keeps this rule quiet on correct code):
+
+* only ``.counter("<literal>")`` / ``.gauge`` / ``.histogram`` /
+  ``.observe`` attribute calls fire, and only when the receiver is
+  recognisably a metrics registry: a name/attribute containing
+  ``metric`` or ``registry`` (``metrics``, ``self.metrics``,
+  ``registry``, ``reg.metrics``), the ``current()`` accessor
+  (``metrics_mod.current().counter(...)`` — the seam the RunLog emit
+  hook uses), or ``self`` inside a ``*Metrics*`` class
+  (``obs/metrics.py``'s own ``record_event`` dispatcher);
+* non-literal names (``counter(name)``) are skipped — they cannot be
+  checked statically and the runtime warning still covers them;
+* other ``.observe`` APIs (rx streams, watchdogs) never match the
+  receiver heuristic.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import json
+import pathlib
+from typing import FrozenSet, Iterable, Optional
+
+from tools.pertlint.core import Finding, Rule, register
+
+_MANIFEST_PATH = (pathlib.Path(__file__).resolve().parents[3]
+                  / "scdna_replication_tools_tpu" / "obs"
+                  / "metrics_manifest.json")
+
+_RECEIVER_HINTS = ("metric", "registry")
+_METHODS = ("counter", "gauge", "histogram", "observe")
+
+
+@functools.lru_cache(maxsize=1)
+def manifest_metric_names() -> FrozenSet[str]:
+    """The metric names pinned by the checked-in manifest; empty when
+    the manifest is unreadable (the rule then stays silent — a missing
+    manifest is the metrics tests' problem, not a lint crash)."""
+    try:
+        doc = json.loads(_MANIFEST_PATH.read_text())
+        return frozenset(doc["metrics"])
+    except (OSError, KeyError, TypeError, ValueError):
+        return frozenset()
+
+
+def _enclosing_metrics_class(node, ctx) -> bool:
+    """Is ``node`` lexically inside a class whose name contains
+    'Metrics'?"""
+    cursor = ctx.parents.get(node)
+    while cursor is not None:
+        if isinstance(cursor, ast.ClassDef) and "Metrics" in cursor.name:
+            return True
+        cursor = ctx.parents.get(cursor)
+    return False
+
+
+def _is_registry_receiver(value, node, ctx) -> bool:
+    """Does the call receiver look like a MetricsRegistry?"""
+    if isinstance(value, ast.Name):
+        if value.id == "self":
+            return _enclosing_metrics_class(node, ctx)
+        return any(h in value.id.lower() for h in _RECEIVER_HINTS)
+    if isinstance(value, ast.Attribute):
+        return any(h in value.attr.lower() for h in _RECEIVER_HINTS)
+    if isinstance(value, ast.Call):
+        func = value.func
+        name = func.id if isinstance(func, ast.Name) else (
+            func.attr if isinstance(func, ast.Attribute) else "")
+        # the current() accessor — same shape as PL009's; the method
+        # whitelist (counter/gauge/histogram/observe vs emit) is what
+        # keeps the runlog and metrics seams apart
+        return name == "current"
+    return False
+
+
+@register
+class UnknownMetricName(Rule):
+    id = "PL012"
+    name = "unknown-metric-name"
+    severity = "error"
+    description = ("metrics-registry call site (.counter/.gauge/"
+                   ".histogram/.observe) whose literal metric name is "
+                   "not in obs/metrics_manifest.json — the metric is "
+                   "excluded from snapshots, untrended by the fleet "
+                   "index and ungated by pert_fleet regress; register "
+                   "it (name, type, labels, buckets) in the manifest "
+                   "first")
+
+    def __init__(self, names: Optional[Iterable[str]] = None):
+        # injectable for tests; default = the checked-in manifest
+        self._names = (manifest_metric_names() if names is None
+                       else frozenset(names))
+
+    def check(self, ctx) -> Iterable[Finding]:
+        if not self._names:
+            return
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METHODS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            if not _is_registry_receiver(node.func.value, node, ctx):
+                continue
+            name = node.args[0].value
+            if name not in self._names:
+                yield self.finding(
+                    ctx, node,
+                    f"metric name {name!r} is not in "
+                    f"obs/metrics_manifest.json — it will be excluded "
+                    f"from metrics_snapshot events (unknown = "
+                    f"unstable), untrended by the fleet index and "
+                    f"ungated by pert_fleet regress; add it to the "
+                    f"manifest (name, type, labels, buckets) first")

@@ -93,16 +93,19 @@ battery() {  # returns 0 only if every step it attempted succeeded
     run_one FULL_PIPELINE_r06_rescue_tpu platform 1500 \
         python tools/full_pipeline_bench.py --run-step3 --mirror-rescue \
             --checkpoint-dir artifacts/ckpt_r06_rescue $DURABLE \
+            --metrics-textfile artifacts/METRICS_r06_rescue_tpu.prom \
             --out artifacts/FULL_PIPELINE_r06_rescue_tpu.json || return 1
     run_one FULL_PIPELINE_r06_5k_tpu platform 3600 \
         python tools/full_pipeline_bench.py --cells 5000 --g1-cells 500 \
             --run-step3 --mirror-rescue \
             --checkpoint-dir artifacts/ckpt_r06_5k $DURABLE \
+            --metrics-textfile artifacts/METRICS_r06_5k_tpu.prom \
             --out artifacts/FULL_PIPELINE_r06_5k_tpu.json || return 1
     run_one FULL_PIPELINE_r06_20kb_tpu platform 2400 \
         python tools/full_pipeline_bench.py --cells 250 --g1-cells 60 \
             --bin-size 20000 --run-step3 --mirror-rescue \
             --checkpoint-dir artifacts/ckpt_r06_20kb $DURABLE \
+            --metrics-textfile artifacts/METRICS_r06_20kb_tpu.prom \
             --out artifacts/FULL_PIPELINE_r06_20kb_tpu.json || return 1
     if [ ! -s artifacts/FULL_PIPELINE_r06_10k_tpu.json ] \
             && [ "$tries_10k" -lt "$MAX_10K_TRIES" ]; then
@@ -111,6 +114,7 @@ battery() {  # returns 0 only if every step it attempted succeeded
             python tools/full_pipeline_bench.py --cells 10000 --g1-cells 1000 \
                 --run-step3 --mirror-rescue --cell-chunk 2500 \
                 --checkpoint-dir artifacts/ckpt_r06_10k $DURABLE \
+                --metrics-textfile artifacts/METRICS_r06_10k_tpu.prom \
                 --out artifacts/FULL_PIPELINE_r06_10k_tpu.json || return 1
     fi
     return 0
@@ -131,6 +135,10 @@ for attempt in $(seq 1 200); do
         if core_done && { [ -s artifacts/FULL_PIPELINE_r06_10k_tpu.json ] \
                           || [ "$tries_10k" -ge "$MAX_10K_TRIES" ]; }; then
             echo "$(stamp) window-runner: battery complete (10k tries=${tries_10k})" >> "$LOG"
+            # fleet-index the battery's run logs so the TPU rounds land
+            # in the same trend/regress surface as the CPU rounds
+            python -m tools.pert_fleet index --roots .pert_runs artifacts \
+                --out artifacts/FLEET_INDEX_r06_tpu.json >> "$LOG" 2>&1 || true
             exit 0
         fi
     fi

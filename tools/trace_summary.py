@@ -92,6 +92,33 @@ def _event_scope(event: dict):
     return None
 
 
+def scope_totals(profile_dir: str) -> dict:
+    """Total device time per ``pert/*`` named scope, in SECONDS, summed
+    across every trace dump (gz or plain) under ``profile_dir``.
+
+    The machine-readable twin of the report's "named_scope groups"
+    section — ``scdna_replication_tools_tpu.api`` feeds these into the
+    run's metrics registry as ``pert_xla_scope_seconds`` gauges, so XLA
+    scope time appears in the ``metrics_snapshot`` events and the
+    Prometheus textfile.  Returns {} (never raises) when the directory
+    holds no readable traces — absent gauges are the degradation
+    contract.
+    """
+    totals: collections.Counter = collections.Counter()
+    for path in _trace_files(profile_dir):
+        try:
+            data = _load_trace(path)
+        except (OSError, ValueError):
+            continue
+        for event in data.get("traceEvents", []):
+            if event.get("ph") != "X":
+                continue
+            scope = _event_scope(event)
+            if scope:
+                totals[scope] += event.get("dur", 0)
+    return {scope: dur / 1e6 for scope, dur in totals.items()}
+
+
 def summarise(profile_dir: str, top: int = 12) -> str:
     lines = [f"# jax.profiler trace summary for {profile_dir}",
              "# top ops by total self-duration per captured trace "
