@@ -119,7 +119,8 @@ class scRT:
                  resume='auto', checkpoint_every=4, faults=None,
                  watchdog_compile_seconds=None,
                  watchdog_chunk_seconds=None,
-                 enum_impl='auto', cn_hmm_self_prob=None,
+                 enum_impl='auto', fused_adam='auto',
+                 optimizer_state_dtype='float32', cn_hmm_self_prob=None,
                  rho_from_rt_prior=False, mirror_rescue=True,
                  compile_cache_dir='auto', telemetry_path='auto',
                  metrics_textfile=None, fit_diag_every=25,
@@ -159,7 +160,8 @@ class scRT:
             checkpoint_every=checkpoint_every, faults=faults,
             watchdog_compile_seconds=watchdog_compile_seconds,
             watchdog_chunk_seconds=watchdog_chunk_seconds,
-            enum_impl=enum_impl,
+            enum_impl=enum_impl, fused_adam=fused_adam,
+            optimizer_state_dtype=optimizer_state_dtype,
             cn_hmm_self_prob=cn_hmm_self_prob,
             rho_from_rt_prior=rho_from_rt_prior,
             mirror_rescue=mirror_rescue,

@@ -40,6 +40,30 @@ def infer_scrt_main(argv=None):
                    help="clone-discovery algorithm used when "
                         "--clone-col none")
     p.add_argument("--num-shards", type=int, default=1)
+    p.add_argument("--enum-impl", default="auto",
+                   choices=["auto", "xla", "pallas", "pallas_interpret",
+                            "binary", "binary_xla", "binary_pallas",
+                            "binary_interpret"],
+                   help="enumerated-likelihood implementation "
+                        "(PertConfig.enum_impl): 'auto' = the fused "
+                        "Pallas kernel on TPU / XLA elsewhere; 'binary' "
+                        "opts into the independent-binary CN encoding "
+                        "(O(log P) pi/optimizer planes; parity-gated — "
+                        "see PERF_NOTES)")
+    p.add_argument("--fused-adam", default="auto",
+                   choices=["auto", "off", "xla", "pallas",
+                            "pallas_interpret"],
+                   help="single-sweep fused Adam update for the pi "
+                        "parameter (PertConfig.fused_adam): 'auto' = "
+                        "Pallas kernel on TPU, stock optax elsewhere")
+    p.add_argument("--optimizer-state-dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="stored dtype of the pi parameter's Adam m/v "
+                        "moments (PertConfig.optimizer_state_dtype); "
+                        "bfloat16 halves the dominant optimizer-state "
+                        "HBM traffic (arithmetic stays float32; "
+                        "mid-budget resume across a dtype change is "
+                        "refused)")
     p.add_argument("--checkpoint-dir", default=None,
                    help="write step-boundary + periodic in-fit "
                         "checkpoints (and the resume manifest) to this "
@@ -129,6 +153,8 @@ def infer_scrt_main(argv=None):
     scrt = scRT(cn_s, cn_g1, clone_col=_parse_clone_col(args.clone_col),
                 cn_prior_method=args.cn_prior_method,
                 max_iter=args.max_iter, num_shards=args.num_shards,
+                enum_impl=args.enum_impl, fused_adam=args.fused_adam,
+                optimizer_state_dtype=args.optimizer_state_dtype,
                 clustering_method=args.clustering_method,
                 checkpoint_dir=args.checkpoint_dir, resume=args.resume,
                 checkpoint_every=args.checkpoint_every,

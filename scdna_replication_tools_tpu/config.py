@@ -136,8 +136,35 @@ class PertConfig:
     # enumerated-likelihood implementation: 'auto' picks the fused Pallas
     # kernel (ops/enum_kernel.py) on TPU (shard_map'd per device when a
     # mesh is active) and the XLA broadcast path elsewhere; 'xla' /
-    # 'pallas' / 'pallas_interpret' force a specific path.
+    # 'pallas' / 'pallas_interpret' force a specific path.  'binary'
+    # opts into the independent-binary CN encoding (arXiv 2206.00093):
+    # the P-way categorical pi parameter becomes Kb = ceil(log2 P)
+    # independent binary logit planes masked to the valid states —
+    # O(log P) instead of O(P) planes for pi-in, dpi-out and the Adam
+    # state (~146 -> ~56 analytic planes/iter at P=13 with sparse etas;
+    # see PERF_NOTES).  Parity-gated against the dense path like sparse
+    # etas (tests/test_binary_encoding.py); same backend policy
+    # ('binary_pallas' on TPU, 'binary_xla' elsewhere,
+    # 'binary_interpret' for CPU kernel tests).
     enum_impl: str = "auto"
+    # fused single-sweep Adam update for the (planes, cells, loci) pi
+    # parameter (ops/adam_kernel.py): reads (grad, param, m, v) and
+    # writes (param, m, v) in ONE streamed kernel instead of XLA's
+    # per-output optax fusions (which stream the gradient twice and
+    # re-read the fresh moments).  'auto' = the Pallas kernel on TPU,
+    # stock optax elsewhere (no HBM roofline to beat on host memory);
+    # 'off' / 'xla' / 'pallas' / 'pallas_interpret' force a path.  The
+    # XLA implementation reproduces the optax trajectory bit-exactly at
+    # float32 moments.
+    fused_adam: str = "auto"
+    # stored dtype of the pi parameter's Adam m/v moments: 'float32'
+    # (default — reference-parity trajectories) or 'bfloat16' (halves
+    # the dominant optimizer-state HBM traffic and residency; the
+    # update arithmetic stays float32).  bfloat16 implies at least the
+    # XLA fused update.  Checkpoints record the dtype and a mid-budget
+    # --resume across a dtype change is REFUSED (it cannot be
+    # bit-exact); see infer/checkpoint.py.
+    optimizer_state_dtype: str = "float32"
     # auto-compact one-hot CN priors (priors.sparsify_etas) to
     # (eta_idx, eta_w) planes, cutting the fused kernel's per-iteration
     # etas HBM stream from 2P planes to 4; False keeps the dense tensor

@@ -105,11 +105,26 @@ def save_step(checkpoint_dir: str, step: str, params: dict,
     flat["meta.nan_abort"] = np.asarray(bool(nan_abort))
     if opt_state is not None:
         # flatten generically; the reader rebuilds the treedef from a
-        # fresh optax init over the restored params (same structure)
+        # fresh optax init over the restored params (same structure).
+        # Dtype-aware (optimizer_state_dtype='bfloat16'): numpy's npz
+        # container cannot round-trip ml_dtypes.bfloat16 (it reloads as
+        # a void dtype), so bfloat16 leaves are stored as uint16 BIT
+        # VIEWS with a per-leaf ``optdtype.N`` sidecar that the loader
+        # uses to view them back — bit-exact both ways.  The summary
+        # ``meta.opt_moment_dtype`` is what the runner's resume gate
+        # compares against the configured dtype.
         import jax
         leaves = jax.tree_util.tree_leaves(opt_state)
+        moment_dtype = "float32"
         for i, leaf in enumerate(leaves):
-            flat[f"opt.{i}"] = np.asarray(leaf)
+            arr = np.asarray(leaf)
+            if arr.dtype.name == "bfloat16":
+                flat[f"opt.{i}"] = arr.view(np.uint16)
+                flat[f"optdtype.{i}"] = np.asarray("bfloat16")
+                moment_dtype = "bfloat16"
+            else:
+                flat[f"opt.{i}"] = arr
+        flat["meta.opt_moment_dtype"] = np.asarray(moment_dtype)
     for k, v in (extra or {}).items():
         flat[f"extra.{k}"] = np.asarray(v)
 
@@ -256,6 +271,15 @@ def _unpack(path: str, data):
     for k in data.files:
         if k.startswith("meta.") or k.startswith("opt."):
             extra[k] = data[k]
+    # bfloat16 moments round-trip: uint16 bit views back to bfloat16
+    # (see save_step) — readers downstream never see the storage trick
+    for k in data.files:
+        if k.startswith("optdtype."):
+            leaf_key = "opt." + k[len("optdtype."):]
+            if str(data[k]) == "bfloat16" and leaf_key in extra:
+                import ml_dtypes
+
+                extra[leaf_key] = extra[leaf_key].view(ml_dtypes.bfloat16)
     version = int(extra.get("meta.format_version", 1))
     if version < 2 and "pi_logits" in params and params["pi_logits"].ndim == 3:
         raise ValueError(

@@ -161,6 +161,13 @@ class PertInference:
             raise ValueError(
                 f"resume must be 'auto', 'force' or 'off', got "
                 f"{config.resume!r}")
+        # fail fast on the optimizer knobs too (resolve_fused_adam /
+        # moment_jnp_dtype raise on unknown values) — surfacing a typo
+        # inside the step-2 fit would waste the whole step-1 fit first
+        from scdna_replication_tools_tpu.ops import adam_kernel
+        self._fused_adam = adam_kernel.resolve_fused_adam(
+            config.fused_adam)
+        adam_kernel.moment_jnp_dtype(config.optimizer_state_dtype)
         self.s = s_data
         self.g1 = g1_data
         self.config = config
@@ -315,7 +322,10 @@ class PertInference:
         crashes into (its README's 20kb-bin warning).  Warn with the
         knobs that avoid it: the fused kernel never materialises the
         tensor, cell_chunk scans it in slabs, sharding divides it."""
-        if spec.step1 or spec.enum_impl != "xla":
+        from scdna_replication_tools_tpu.ops.enum_kernel import (
+            enum_impl_backend,
+        )
+        if spec.step1 or enum_impl_backend(spec.enum_impl) != "xla":
             return
         cells, loci = batch.reads.shape
         if self._mesh is not None:
@@ -571,7 +581,23 @@ class PertInference:
             return StepOutput(fit, spec, fixed, batch, 0.0)
         # partial step: resume from the saved iteration with Adam
         # moments (and, for chunked fits, the controller ledger) intact
-        # — exact continuation of the trajectory
+        # — exact continuation of the trajectory.  The moments' stored
+        # dtype is part of that contract: resuming float32 moments
+        # under optimizer_state_dtype='bfloat16' (or vice versa) CANNOT
+        # be bit-exact — the continued trajectory would silently
+        # diverge from both the uninterrupted run and a fresh fit — so
+        # a dtype mismatch refuses loudly instead of degrading.
+        saved_dt = str(extra.get("meta.opt_moment_dtype", "float32"))
+        has_opt = any(k.startswith("opt.") for k in extra)
+        if has_opt and saved_dt != cfg.optimizer_state_dtype:
+            raise ValueError(
+                f"checkpoint for {step_name} in {cfg.checkpoint_dir} "
+                f"stores Adam moments as {saved_dt} but this run "
+                f"configures optimizer_state_dtype="
+                f"{cfg.optimizer_state_dtype!r}: a mid-budget resume "
+                "across moment dtypes cannot be bit-exact — rerun with "
+                f"optimizer_state_dtype='{saved_dt}', or resume='off' "
+                "to refit the step fresh")
         opt_state0 = ckpt.restore_opt_state(
             extra, params, cfg.learning_rate, cfg.adam_b1, cfg.adam_b2)
         losses_prefix = np.asarray(losses)[:num_iters]
@@ -613,10 +639,30 @@ class PertInference:
             batch, params0 = self._maybe_shard(batch, params0)
             batch, params0, fixed = jax.block_until_ready(
                 (batch, params0, fixed))
-        mesh = self._mesh if spec.enum_impl in ("pallas",
-                                                "pallas_interpret") else None
+        from scdna_replication_tools_tpu.ops.enum_kernel import (
+            enum_impl_backend,
+        )
+        mesh = self._mesh \
+            if enum_impl_backend(spec.enum_impl) != "xla" else None
 
         loss_fn = _PertLossFn(spec=spec, mesh=mesh)
+
+        if not spec.step1:
+            # analytic per-iteration HBM traffic of this step's fused
+            # iteration (ops/enum_kernel.planes_per_iter) — a STABLE
+            # gauge, so it rides the metrics_snapshot events into the
+            # fleet index and the regression gate holds encoding wins
+            # (binary vs categorical, bf16 vs f32 moments)
+            from scdna_replication_tools_tpu.ops.enum_kernel import (
+                enum_impl_binary,
+                planes_per_iter,
+            )
+            metrics_mod.current().gauge(
+                "pert_planes_moved_per_iter",
+                labels={"step": step_name}).set(planes_per_iter(
+                    spec.P, binary=enum_impl_binary(spec.enum_impl),
+                    sparse_etas=spec.sparse_etas,
+                    moment_dtype=cfg.optimizer_state_dtype))
 
         controller = None
         if self._controller_active(min_iter, max_iter):
@@ -677,7 +723,9 @@ class PertInference:
                           checkpoint_cb=checkpoint_cb,
                           resume_state=resume_ctrl,
                           compile_deadline=cfg.watchdog_compile_seconds,
-                          chunk_deadline=cfg.watchdog_chunk_seconds)
+                          chunk_deadline=cfg.watchdog_chunk_seconds,
+                          fused_adam=self._fused_adam,
+                          moment_dtype=cfg.optimizer_state_dtype)
         wall = time.perf_counter() - t0
         for key in ("trace", "compile", "fit"):
             self.phases.add(f"{step_name}/{key}", fit.timings.get(key, 0.0))
@@ -1084,13 +1132,17 @@ class PertInference:
             else to_positive(out.fit.params["a_raw"])
 
         # np.array (copy): np.asarray of a jax array is a read-only view,
-        # and the accepted cells are spliced into these buffers below
+        # and the accepted cells are spliced into these buffers below.
+        # The pi parameter's key depends on the encoding ('pi_logits'
+        # categorical / 'pi_bin_logits' binary) but both are
+        # (planes, cells, loci), so the slice/splice code is shared.
+        pi_key = "pi_bin_logits" if out.spec.binary_pi else "pi_logits"
         params_np = {k: np.array(v) for k, v in out.fit.params.items()}
         orig_sub = {
             "tau_raw": jnp.asarray(params_np["tau_raw"][cand]),
             "u": jnp.asarray(params_np["u"][cand]),
             "betas": jnp.asarray(params_np["betas"][cand]),
-            "pi_logits": jnp.asarray(params_np["pi_logits"][:, cand, :]),
+            pi_key: jnp.asarray(params_np[pi_key][:, cand, :]),
             "beta_stds_raw": jnp.asarray(params_np["beta_stds_raw"]),
         }
 
@@ -1115,7 +1167,9 @@ class PertInference:
                       max_iter=cfg.mirror_max_iter,
                       min_iter=cfg.mirror_min_iter,
                       rel_tol=cfg.rel_tol, learning_rate=cfg.learning_rate,
-                      b1=cfg.adam_b1, b2=cfg.adam_b2)
+                      b1=cfg.adam_b1, b2=cfg.adam_b2,
+                      fused_adam=self._fused_adam,
+                      moment_dtype=cfg.optimizer_state_dtype)
 
         # compare under the ORIGINAL beta_stds (a global pyro param the
         # sub-fit also moves; discarding its drift keeps the per-cell
@@ -1143,7 +1197,7 @@ class PertInference:
         res_np = {k: np.asarray(v) for k, v in rescued.items()}
         for key in ("tau_raw", "u", "betas"):
             params_np[key][keep] = res_np[key][accept]
-        params_np["pi_logits"][:, keep, :] = res_np["pi_logits"][:, accept, :]
+        params_np[pi_key][:, keep, :] = res_np[pi_key][:, accept, :]
         new_params = {k: jnp.asarray(v) for k, v in params_np.items()}
         new_fit = dataclasses.replace(out.fit, params=new_params)
         return dataclasses.replace(out, fit=new_fit)

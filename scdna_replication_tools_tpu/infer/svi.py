@@ -34,6 +34,7 @@ import optax
 
 from scdna_replication_tools_tpu.obs import doctor as _doctor
 from scdna_replication_tools_tpu.obs import runlog as _runlog
+from scdna_replication_tools_tpu.ops import adam_kernel as _adam_kernel
 from scdna_replication_tools_tpu.utils import faults as _faults
 
 # fixed slot count of the in-fit diagnostics ring buffer: large enough
@@ -85,16 +86,72 @@ def _window_stat(losses, i, win_size):
     return jnp.max(win) - jnp.min(win)
 
 
+def _pi_param_name(params: dict) -> Optional[str]:
+    """The (planes, cells, loci) pi parameter's key: 'pi_bin_logits'
+    under the independent-binary CN encoding, 'pi_logits' under the
+    categorical one, None for pytrees that carry neither (generic
+    fit_map callers)."""
+    for name in ("pi_bin_logits", "pi_logits"):
+        if name in params:
+            return name
+    return None
+
+
+def _effective_fused_adam(fused_adam: str, moment_dtype: str) -> str:
+    """bfloat16 moments REQUIRE the custom update (the stock optax chain
+    would widen them back to float32 mid-loop and break the while-loop
+    carry dtype contract) — promote 'off' to the XLA implementation."""
+    if fused_adam == "off" and moment_dtype != "float32":
+        return "xla"
+    return fused_adam
+
+
+def _fused_adam_apply(params: dict, grads: dict, opt_state, lr, b1, b2,
+                      impl: str, moment_dtype: str):
+    """One optimizer step through the fused-Adam path, preserving the
+    optax.adam state PYTREE (ScaleByAdamState + the scale stage's empty
+    state) so checkpoints, resume and ``make_opt_state``'s treedef-donor
+    role are untouched — only how the leaves are computed changes.  The
+    big (planes, cells, loci) pi parameter goes through the selected
+    kernel (and the configured moment dtype); every other leaf takes
+    the same single-sweep math as plain XLA ops (they are O(cells) /
+    O(loci) — noise either way)."""
+    inner = opt_state[0]
+    rest = tuple(opt_state[1:])
+    count = optax.safe_int32_increment(inner.count)
+    pi_name = _pi_param_name(params)
+    new_params: dict = {}
+    new_mu: dict = {}
+    new_nu: dict = {}
+    for k in params:
+        is_pi = k == pi_name
+        p2, m2, v2 = _adam_kernel.adam_plane_update(
+            params[k], grads[k], inner.mu[k], inner.nu[k], lr, b1, b2,
+            count, impl=impl if is_pi else "xla",
+            moment_dtype=moment_dtype if is_pi else "float32")
+        new_params[k], new_mu[k], new_nu[k] = p2, m2, v2
+    return new_params, (inner._replace(count=count, mu=new_mu,
+                                       nu=new_nu),) + rest
+
+
 def _fit_loop(loss_fn: Callable, lr, b1: float, b2: float,
               loss_args: tuple, diag_every: int, conv_window: int,
-              bound, min_iter, rel_tol, init):
+              bound, min_iter, rel_tol, init,
+              fused_adam: str = "off", moment_dtype: str = "float32"):
     """The shared per-iteration fit loop of :func:`_run_fit` and
     :func:`_run_fit_chunk` — ONE copy of the iteration math, so the
     fixed and chunked paths cannot drift apart.  ``bound`` / ``min_iter``
     / ``rel_tol`` / ``lr`` may be Python scalars (fixed path: baked into
     the program) or traced device scalars (chunk path: one program
     serves every chunk of every budget); ``conv_window`` is always
-    static (it sizes a dynamic_slice)."""
+    static (it sizes a dynamic_slice).
+
+    ``fused_adam`` (static) selects the optimizer-update path:
+    ``'off'`` keeps the stock optax chain bit-exactly; ``'xla'`` /
+    ``'pallas'`` / ``'pallas_interpret'`` route the big pi parameter
+    through the single-sweep fused update (ops/adam_kernel.py) with its
+    stored moments in ``moment_dtype``."""
+    fused_adam = _effective_fused_adam(fused_adam, moment_dtype)
     tx = optax.adam(learning_rate=lr, b1=b1, b2=b2)
 
     value_and_grad = jax.value_and_grad(loss_fn)
@@ -133,8 +190,13 @@ def _fit_loop(loss_fn: Callable, lr, b1: float, b2: float,
             diag = jax.lax.cond(i % diag_every == 0, _record,
                                 lambda d: d, diag)
 
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        if fused_adam != "off":
+            params, opt_state = _fused_adam_apply(
+                params, grads, opt_state, lr, b1, b2, fused_adam,
+                moment_dtype)
+        else:
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
         losses = losses.at[i].set(loss)
 
         is_nan = jnp.isnan(loss)
@@ -158,9 +220,10 @@ def _fit_loop(loss_fn: Callable, lr, b1: float, b2: float,
 # mirror-rescue aliasing bug class, and a drifted copy of this list in
 # the lint layer would make that audit lie.
 FIT_STATIC_ARGNAMES = ("loss_fn", "max_iter", "min_iter", "lr", "b1", "b2",
-                       "diag_every")
+                       "diag_every", "fused_adam", "moment_dtype")
 FIT_DONATE_ARGNAMES = ("params0", "opt_state0", "losses0", "diag0")
-CHUNK_STATIC_ARGNAMES = ("loss_fn", "conv_window", "b1", "b2", "diag_every")
+CHUNK_STATIC_ARGNAMES = ("loss_fn", "conv_window", "b1", "b2", "diag_every",
+                         "fused_adam", "moment_dtype")
 CHUNK_DONATE_ARGNAMES = ("opt_state0", "losses0", "diag0")
 
 
@@ -177,12 +240,14 @@ CHUNK_DONATE_ARGNAMES = ("opt_state0", "losses0", "diag0")
 def _run_fit(loss_fn: Callable, params0: dict, opt_state0, losses0, diag0,
              i0, loss_args: tuple,
              max_iter: int, min_iter: int, rel_tol: float,
-             lr: float, b1: float, b2: float, diag_every: int):
+             lr: float, b1: float, b2: float, diag_every: int,
+             fused_adam: str = "off", moment_dtype: str = "float32"):
     init = (jnp.asarray(i0), params0, opt_state0, losses0, diag0,
             jnp.asarray(False), jnp.asarray(False), jnp.asarray(False))
     # window clamped so tiny smoke-test budgets (max_iter < 9) compile
     return _fit_loop(loss_fn, lr, b1, b2, loss_args, diag_every,
-                     min(9, max_iter), max_iter, min_iter, rel_tol, init)
+                     min(9, max_iter), max_iter, min_iter, rel_tol, init,
+                     fused_adam=fused_adam, moment_dtype=moment_dtype)
 
 
 # Chunked twin of ``_run_fit`` for the adaptive controller
@@ -204,18 +269,40 @@ def _run_fit_chunk(loss_fn: Callable, params0: dict, opt_state0, losses0,
                    diag0, i0, stop, min_iter, rel_tol, lr,
                    loss_args: tuple,
                    conv_window: int, b1: float, b2: float,
-                   diag_every: int):
+                   diag_every: int,
+                   fused_adam: str = "off", moment_dtype: str = "float32"):
     init = (i0, params0, opt_state0, losses0, diag0,
             jnp.asarray(False), jnp.asarray(False), jnp.asarray(False))
     return _fit_loop(loss_fn, lr, b1, b2, loss_args, diag_every,
-                     conv_window, stop, min_iter, rel_tol, init)
+                     conv_window, stop, min_iter, rel_tol, init,
+                     fused_adam=fused_adam, moment_dtype=moment_dtype)
 
 
 def make_opt_state(params: dict, learning_rate: float = 0.05,
-                   b1: float = 0.8, b2: float = 0.99):
+                   b1: float = 0.8, b2: float = 0.99,
+                   moment_dtype: str = "float32"):
     """Fresh Adam state for ``params`` — also the treedef donor when
-    restoring a checkpointed state from flat leaves."""
-    return optax.adam(learning_rate=learning_rate, b1=b1, b2=b2).init(params)
+    restoring a checkpointed state from flat leaves (the treedef is
+    dtype-independent, so the donor role never needs the dtype).
+
+    ``moment_dtype='bfloat16'`` stores the big pi parameter's m/v
+    moments in bfloat16 (PertConfig.optimizer_state_dtype): half the
+    optimizer-state HBM traffic and residency for the one parameter
+    that dominates both.  The arithmetic stays float32 — see
+    ops/adam_kernel.py."""
+    state = optax.adam(learning_rate=learning_rate, b1=b1,
+                       b2=b2).init(params)
+    if moment_dtype != "float32":
+        dt = _adam_kernel.moment_jnp_dtype(moment_dtype)
+        pi = _pi_param_name(params) if isinstance(params, dict) else None
+        if pi is not None:
+            inner = state[0]
+            mu = dict(inner.mu)
+            nu = dict(inner.nu)
+            mu[pi] = mu[pi].astype(dt)
+            nu[pi] = nu[pi].astype(dt)
+            state = (inner._replace(mu=mu, nu=nu),) + tuple(state[1:])
+    return state
 
 
 # ---------------------------------------------------------------------------
@@ -343,6 +430,7 @@ def fit_map(loss_fn: Callable, params0: dict, loss_args: tuple = (),
             resume_state: Optional[dict] = None,
             compile_deadline: Optional[float] = None,
             chunk_deadline: Optional[float] = None,
+            fused_adam: str = "off", moment_dtype: str = "float32",
             ) -> FitResult:
     """Fit ``params`` by MAP ascent of ``-loss_fn`` with reference semantics.
 
@@ -405,7 +493,17 @@ def fit_map(loss_fn: Callable, params0: dict, loss_args: tuple = (),
     decision trail bit-exactly.  ``compile_deadline``/``chunk_deadline``
     arm the per-phase watchdog (``utils.faults.run_with_deadline``),
     turning hangs into typed, checkpointed aborts.
+
+    ``fused_adam`` (already resolved: 'off'/'xla'/'pallas'/
+    'pallas_interpret') routes the big pi parameter's Adam update
+    through the single-sweep fused kernel (ops/adam_kernel.py) instead
+    of the stock optax chain; ``moment_dtype`` ('float32'/'bfloat16')
+    selects the STORED dtype of that parameter's m/v moments (bfloat16
+    implies at least the XLA fused update — optax would widen the
+    carry).  'off' + 'float32' (the defaults) reproduce the previous
+    optax path bit-exactly.
     """
+    fused_adam = _effective_fused_adam(str(fused_adam), str(moment_dtype))
     if controller is not None and diag_every:
         return _fit_map_controlled(
             loss_fn, params0, loss_args, max_iter=max_iter,
@@ -417,10 +515,12 @@ def fit_map(loss_fn: Callable, params0: dict, loss_args: tuple = (),
             escalate_tag=escalate_tag,
             checkpoint_every=checkpoint_every, checkpoint_cb=checkpoint_cb,
             resume_state=resume_state, compile_deadline=compile_deadline,
-            chunk_deadline=chunk_deadline)
+            chunk_deadline=chunk_deadline,
+            fused_adam=fused_adam, moment_dtype=moment_dtype)
     if opt_state0 is None:
         params0 = jax.tree_util.tree_map(jnp.asarray, params0)
-        opt_state0 = make_opt_state(params0, learning_rate, b1, b2)
+        opt_state0 = make_opt_state(params0, learning_rate, b1, b2,
+                                    moment_dtype=moment_dtype)
     else:
         # resume path: the caller is handing over a previous FitResult's
         # LIVE params/opt_state.  jnp.asarray would alias them, donation
@@ -449,7 +549,8 @@ def fit_map(loss_fn: Callable, params0: dict, loss_args: tuple = (),
     rel_tol = float(rel_tol)
     static_kwargs = dict(max_iter=int(max_iter), min_iter=int(min_iter),
                          lr=float(learning_rate), b1=float(b1),
-                         b2=float(b2), diag_every=diag_every)
+                         b2=float(b2), diag_every=diag_every,
+                         fused_adam=fused_adam, moment_dtype=moment_dtype)
     dynamic_args = (params0, opt_state0, losses0, diag0, i0, loss_args)
     timings: dict = {"trace": 0.0, "compile": 0.0}
     compiled = _resolve_program(_run_fit, "fit", loss_fn, dynamic_args,
@@ -517,7 +618,9 @@ def _fit_map_controlled(loss_fn: Callable, params0: dict, loss_args: tuple,
                         checkpoint_every: int = 0, checkpoint_cb=None,
                         resume_state: Optional[dict] = None,
                         compile_deadline: Optional[float] = None,
-                        chunk_deadline: Optional[float] = None
+                        chunk_deadline: Optional[float] = None,
+                        fused_adam: str = "off",
+                        moment_dtype: str = "float32"
                         ) -> FitResult:
     """Adaptive (chunked) twin of :func:`fit_map` — see its docstring.
 
@@ -552,7 +655,8 @@ def _fit_map_controlled(loss_fn: Callable, params0: dict, loss_args: tuple,
 
     if opt_state0 is None:
         params0 = jax.tree_util.tree_map(jnp.asarray, params0)
-        opt_state0 = make_opt_state(params0, learning_rate, b1, b2)
+        opt_state0 = make_opt_state(params0, learning_rate, b1, b2,
+                                    moment_dtype=moment_dtype)
     else:
         # resume path: copy before the chunk program donates (see
         # fit_map's fixed path — same contract)
@@ -576,7 +680,8 @@ def _fit_map_controlled(loss_fn: Callable, params0: dict, loss_args: tuple,
         diag_i0 = int(resume_state.get("diag_i0", 0))
 
     static_kwargs = dict(conv_window=min(9, max_iter), b1=float(b1),
-                         b2=float(b2), diag_every=diag_every)
+                         b2=float(b2), diag_every=diag_every,
+                         fused_adam=fused_adam, moment_dtype=moment_dtype)
     # dynamic scalars with pinned dtypes so every chunk hits the same
     # compiled program
     as_i32 = functools.partial(jnp.asarray, dtype=jnp.int32)
@@ -653,7 +758,7 @@ def _fit_map_controlled(loss_fn: Callable, params0: dict, loss_args: tuple,
             escalate_dir=escalate_dir, escalate_tag=escalate_tag,
             fault_site=fault_site, chunk_deadline=chunk_deadline,
             checkpoint_every=checkpoint_every,
-            checkpoint_cb=checkpoint_cb,
+            checkpoint_cb=checkpoint_cb, moment_dtype=moment_dtype,
             decisions=decisions, best_loss=best_loss,
             best_params=best_params, best_it=best_it, reseeds=reseeds,
             extra_granted=extra_granted, nan_retries=nan_retries,
@@ -757,7 +862,8 @@ def _chunk_loop(*, run_chunk, params, opt_state, losses, diag, i_host,
                 chunk_deadline, checkpoint_every, checkpoint_cb,
                 decisions, best_loss, best_params,
                 best_it, reseeds, extra_granted, nan_retries,
-                prev_verdict, stagnation_anchor, snap: dict):
+                prev_verdict, stagnation_anchor, snap: dict,
+                moment_dtype: str = "float32"):
     """The host-side chunk loop of :func:`_fit_map_controlled`.
 
     ``snap`` is the caller-owned live-state snapshot: refreshed with
@@ -872,7 +978,8 @@ def _chunk_loop(*, run_chunk, params, opt_state, losses, diag, i_host,
             nan_retries += 1
             lr_now = lr_now * float(policy.nan_lr_factor)
             params = best_params
-            opt_state = make_opt_state(best_params, lr_now, b1, b2)
+            opt_state = make_opt_state(best_params, lr_now, b1, b2,
+                                       moment_dtype=moment_dtype)
             # redo from the checkpointed iteration: every poisoned
             # losses/diag entry beyond it is overwritten as the retry
             # re-runs those iterations
@@ -927,7 +1034,8 @@ def _chunk_loop(*, run_chunk, params, opt_state, losses, diag, i_host,
             reseeds += 1
             params = _perturb_params(best_params, policy.reseed_scale,
                                      policy.seed, reseeds)
-            opt_state = make_opt_state(params, lr_now, b1, b2)
+            opt_state = make_opt_state(params, lr_now, b1, b2,
+                                       moment_dtype=moment_dtype)
             prev_verdict = None  # the perturbed trajectory is a new
             # regime — instability must re-prove persistence, and the
             # stagnation stop must not cancel the restart against the

@@ -108,6 +108,11 @@ def param_specs(lx: Optional[str]) -> dict:
         "u": P(CELLS_AXIS),
         "betas": P(CELLS_AXIS, None),
         "pi_logits": state_major_spec(CELLS_AXIS, lx),
+        # independent-binary encoding (enum_impl='binary'): the Kb =
+        # ceil(log2 P) binary logit planes replace pi_logits; same
+        # plane-major layout, same placement (the plane axis is tiny
+        # and never sharded)
+        "pi_bin_logits": state_major_spec(CELLS_AXIS, lx),
     }
 
 
@@ -134,6 +139,29 @@ def fused_shard_specs(mesh: Mesh):
 def fused_sparse_shard_specs(mesh: Mesh):
     """(in_specs, out_specs) for shard_map over
     ``enum_loglik_fused_sparse``: (reads, mu, pi_logits[STATE-major],
+    phi, eta_idx, eta_w, lamb) -> ll."""
+    cells, lx = mesh_axes(mesh)
+    bins = bin_spec(cells, lx)
+    in_specs = (bins, bins, state_major_spec(cells, lx), bins, bins, bins,
+                P())
+    return in_specs, bins
+
+
+def fused_binary_shard_specs(mesh: Mesh):
+    """(in_specs, out_specs) for shard_map over
+    ``enum_loglik_fused_binary``: (reads, mu, zbin[plane-major], phi,
+    etas[STATE-major], lamb) -> ll.  The Kb binary planes place exactly
+    like the P categorical planes (plane axis unsharded)."""
+    cells, lx = mesh_axes(mesh)
+    bins = bin_spec(cells, lx)
+    in_specs = (bins, bins, state_major_spec(cells, lx), bins,
+                state_major_spec(cells, lx), P())
+    return in_specs, bins
+
+
+def fused_sparse_binary_shard_specs(mesh: Mesh):
+    """(in_specs, out_specs) for shard_map over
+    ``enum_loglik_fused_sparse_binary``: (reads, mu, zbin[plane-major],
     phi, eta_idx, eta_w, lamb) -> ll."""
     cells, lx = mesh_axes(mesh)
     bins = bin_spec(cells, lx)
@@ -182,6 +210,7 @@ _PARAM_DIMS = {
     "u": ("cells",),
     "betas": ("cells", "K1"),
     "pi_logits": ("P", "cells", "loci"),
+    "pi_bin_logits": ("Kb", "cells", "loci"),
 }
 
 # the shard_map kernel factories: (factory, in-tensor names, out name);
@@ -202,6 +231,18 @@ _SHARD_MAP_DIMS = {
     "fused_sparse_shard_specs": (
         ("reads", "mu", "pi_logits_t", "phi", "eta_idx", "eta_w", "lamb"),
         (("cells", "loci"), ("cells", "loci"), ("P", "cells", "loci"),
+         ("cells", "loci"), ("cells", "loci"), ("cells", "loci"), ()),
+        ("cells", "loci"),
+    ),
+    "fused_binary_shard_specs": (
+        ("reads", "mu", "zbin_t", "phi", "etas_t", "lamb"),
+        (("cells", "loci"), ("cells", "loci"), ("Kb", "cells", "loci"),
+         ("cells", "loci"), ("P", "cells", "loci"), ()),
+        ("cells", "loci"),
+    ),
+    "fused_sparse_binary_shard_specs": (
+        ("reads", "mu", "zbin_t", "phi", "eta_idx", "eta_w", "lamb"),
+        (("cells", "loci"), ("cells", "loci"), ("Kb", "cells", "loci"),
          ("cells", "loci"), ("cells", "loci"), ("cells", "loci"), ()),
         ("cells", "loci"),
     ),
@@ -244,7 +285,8 @@ def contract_entries(mesh) -> List[ContractEntry]:
                                      _PARAM_DIMS[name]))
 
     for factory in (enum_shard_specs, fused_shard_specs,
-                    fused_sparse_shard_specs):
+                    fused_sparse_shard_specs, fused_binary_shard_specs,
+                    fused_sparse_binary_shard_specs):
         names, in_dims, out_dims = _SHARD_MAP_DIMS[factory.__name__]
         in_specs, out_spec = factory(mesh)
         if len(in_specs) != len(names):

@@ -113,8 +113,20 @@ class PertModelSpec:
     # enumerated-likelihood implementation: 'xla' (dense broadcast tensor,
     # the fallback + parity oracle), 'pallas' (fused TPU kernel, see
     # ops/enum_kernel.py) or 'pallas_interpret' (kernel via interpreter,
-    # CPU tests only)
+    # CPU tests only).  The 'binary_*' triplet selects the
+    # independent-binary CN encoding (arXiv 2206.00093): the categorical
+    # pi_logits parameter is reparameterised as Kb = ceil(log2 P)
+    # independent binary logit planes ('pi_bin_logits'), masked to the P
+    # valid states — same backend split ('binary_xla' /
+    # 'binary_pallas' / 'binary_interpret').
     enum_impl: str = "xla"
+
+    @property
+    def binary_pi(self) -> bool:
+        """True when the pi parameter uses the independent-binary
+        encoding ('pi_bin_logits', Kb planes) instead of the P-plane
+        categorical 'pi_logits'."""
+        return self.enum_impl.startswith("binary")
 
 
 class PertBatch:
@@ -267,6 +279,9 @@ def init_params(spec: PertModelSpec, batch: PertBatch, fixed: dict,
     # changes each step, so XLA cannot hoist it) plus a third for the
     # returned gradient — at genome scale more HBM traffic than the
     # kernel itself.
+    if spec.binary_pi:
+        params["pi_bin_logits"] = _init_binary_pi(spec, batch)
+        return params
     if not spec.step1 and batch.etas is not None:
         pi0 = batch.etas / jnp.sum(batch.etas, axis=-1, keepdims=True)
         params["pi_logits"] = state_major(
@@ -283,6 +298,74 @@ def init_params(spec: PertModelSpec, batch: PertBatch, fixed: dict,
                                         jnp.float32)
 
     return params
+
+
+def _init_binary_pi(spec: PertModelSpec, batch: PertBatch) -> jnp.ndarray:
+    """(Kb, cells, loci) initial binary logit planes for the
+    independent-binary pi encoding.
+
+    The binary parameterisation cannot represent an arbitrary simplex
+    point (it is a rank-Kb factorisation of the P logits), so the init
+    targets the same MODE the dense init encodes rather than the exact
+    distribution:
+
+    * sparse one-hot prior: ``z_k = log1p(w) * (2 bit_k(idx) - 1)``
+      puts the masked softmax's unique argmax at ``idx`` with a margin
+      of at least ``log1p(w)`` over every other valid state (a +1 bit
+      agreeing adds log1p(w), a disagreeing bit subtracts it), and
+      ``w = 0`` (uniform bins) gives z = 0 — uniform, matching the
+      dense init;
+    * dense etas: the paper's mean-field fit — per-bit marginals
+      ``q_k = sum_s bit_k(s) pi0_s`` of the prior-mean simplex,
+      ``z_k = logit(q_k)``;
+    * no prior (step 1 / uniform): zeros.
+    """
+    from scdna_replication_tools_tpu.ops.enum_kernel import (
+        binary_code_matrix,
+        binary_code_width,
+    )
+
+    num_cells, num_loci = batch.reads.shape
+    Kb = binary_code_width(spec.P)
+    if not spec.step1 and batch.eta_idx is not None:
+        kk = jnp.arange(Kb, dtype=jnp.int32)[:, None, None]
+        idx = batch.eta_idx[None].astype(jnp.int32)
+        bits = ((idx // (2 ** kk)) % 2).astype(jnp.float32)
+        return jnp.log1p(batch.eta_w)[None] * (2.0 * bits - 1.0)
+    if not spec.step1 and batch.etas is not None:
+        B = jnp.asarray(binary_code_matrix(spec.P))
+        pi0 = batch.etas / jnp.sum(batch.etas, axis=-1, keepdims=True)
+        q = jnp.clip(jnp.einsum("clp,pk->clk", pi0, B), 1e-6, 1.0 - 1e-6)
+        return state_major(jnp.log(q) - jnp.log1p(-q))
+    return jnp.zeros((Kb, num_cells, num_loci), jnp.float32)
+
+
+def binary_log_pi(spec: PertModelSpec, zbin_t: jnp.ndarray) -> jnp.ndarray:
+    """(cells, loci, P) log-softmax over the valid states from the
+    (Kb, cells, loci) binary logit planes — the XLA materialisation of
+    the encoding (the fused binary kernels reconstruct the same
+    per-state logits in VMEM and never materialise this tensor; see
+    ops/enum_kernel._state_logit_tiles).  Valid-state masking is by
+    construction: only codes 0..P-1 are expanded."""
+    from scdna_replication_tools_tpu.ops.enum_kernel import (
+        binary_code_matrix,
+    )
+
+    B = jnp.asarray(binary_code_matrix(spec.P))
+    logits = jnp.einsum("kcl,pk->clp", zbin_t, B)
+    return jax.nn.log_softmax(logits, axis=-1)
+
+
+def _enum_backend(impl: str) -> str:
+    """The impl's execution backend ('xla'/'pallas'/'pallas_interpret')
+    — ops.enum_kernel.enum_impl_backend owns the mapping (the encoding
+    and the backend are orthogonal axes of the enum_impl value); lazy
+    import, like every enum_kernel access in this module."""
+    from scdna_replication_tools_tpu.ops.enum_kernel import (
+        enum_impl_backend,
+    )
+
+    return enum_impl_backend(impl)
 
 
 def _loci_mean(x: jnp.ndarray, lmask: jnp.ndarray) -> jnp.ndarray:
@@ -329,9 +412,15 @@ def constrained(spec: PertModelSpec, params: dict, fixed: dict) -> dict:
     # give -inf and NaN gradients under the huge 1e6 prior concentrations).
     # The parameter is state-major (P, cells, loci) — see init_params;
     # out["log_pi"] keeps the (cells, loci, P) convention its consumers
-    # (decode, step-1 gather, XLA enum path) expect.
-    out["log_pi"] = cells_major(
-        jax.nn.log_softmax(params["pi_logits"], axis=0))
+    # (decode, step-1 gather, XLA enum path) expect.  Under the binary
+    # encoding the P-state tensor is expanded from the Kb logit planes
+    # here; on the fused training paths this materialisation is dead
+    # code XLA eliminates (the kernel reads the planes directly).
+    if "pi_bin_logits" in params:
+        out["log_pi"] = binary_log_pi(spec, params["pi_bin_logits"])
+    else:
+        out["log_pi"] = cells_major(
+            jax.nn.log_softmax(params["pi_logits"], axis=0))
     out["pi"] = jnp.exp(out["log_pi"])
     return out
 
@@ -454,9 +543,13 @@ def _enum_bin_loglik(spec, reads, u, omega, log_pi, phi, lamb, log_lamb,
         fn = _shard_mapped(enum_loglik, mesh, enum_shard_specs(mesh),
                            interpret)
         return fn(reads, mu, log_pi, phi, lamb)
-    if spec.enum_impl != "xla":
+    if spec.enum_impl not in ("xla", "binary_xla"):
+        # 'binary_xla' reaches here with log_pi already materialised
+        # from the Kb planes (constrained/binary_log_pi) — the dense
+        # joint path is encoding-agnostic given log_pi
         raise ValueError(f"unknown enum_impl {spec.enum_impl!r}; expected "
-                         "'xla', 'pallas' or 'pallas_interpret'")
+                         "'xla', 'pallas', 'pallas_interpret' or a "
+                         "'binary_*' variant")
     joint = _joint_logits(spec.P, reads, u, omega, log_pi, phi, lamb,
                           log_lamb, log1m_lamb)
     return logsumexp(joint, axis=(-2, -1))
@@ -515,6 +608,66 @@ def _enum_bin_loglik_fused_sparse(spec, reads, u, omega, pi_logits_t, phi,
     fn = _shard_mapped(enum_loglik_fused_sparse, mesh,
                        fused_sparse_shard_specs(mesh), interpret)
     return fn(reads, mu, pi_logits_t, phi, eta_idx, eta_w, lamb)
+
+
+def _enum_bin_loglik_fused_binary(spec, reads, u, omega, zbin_t, phi,
+                                  etas_t, lamb, mesh=None):
+    """Independent-binary twin of :func:`_enum_bin_loglik_fused`:
+    ``zbin_t`` is the (Kb, cells, loci) binary logit parameter, and the
+    kernel reconstructs the P per-state logits in VMEM — O(log P) pi
+    HBM streams instead of O(P) (ops/enum_kernel, arXiv 2206.00093)."""
+    from scdna_replication_tools_tpu.layout import fused_binary_shard_specs
+    from scdna_replication_tools_tpu.ops.enum_kernel import (
+        enum_loglik_fused_binary,
+    )
+
+    _require_fixed_lamb(spec)
+    mu = u[:, None] * omega
+    interpret = spec.enum_impl == "binary_interpret"
+    if mesh is None:
+        return enum_loglik_fused_binary(reads, mu, zbin_t, phi, etas_t,
+                                        lamb, spec.P, interpret)
+
+    def fn(reads_, mu_, z_, phi_, etas_, lamb_):
+        return enum_loglik_fused_binary(reads_, mu_, z_, phi_, etas_,
+                                        lamb_, spec.P, interpret)
+
+    in_specs, out_specs = fused_binary_shard_specs(mesh)
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)(
+        reads, mu, zbin_t, phi, etas_t, lamb)
+
+
+def _enum_bin_loglik_fused_sparse_binary(spec, reads, u, omega, zbin_t,
+                                         phi, eta_idx, eta_w, lamb,
+                                         mesh=None):
+    """The production binary pairing: Kb binary logit planes + the
+    one-hot sparse Dirichlet encoding — the ~28-plane kernel of the
+    PERF_NOTES traffic table."""
+    from scdna_replication_tools_tpu.layout import (
+        fused_sparse_binary_shard_specs,
+    )
+    from scdna_replication_tools_tpu.ops.enum_kernel import (
+        enum_loglik_fused_sparse_binary,
+    )
+
+    _require_fixed_lamb(spec)
+    mu = u[:, None] * omega
+    interpret = spec.enum_impl == "binary_interpret"
+    if mesh is None:
+        return enum_loglik_fused_sparse_binary(reads, mu, zbin_t, phi,
+                                               eta_idx, eta_w, lamb,
+                                               spec.P, interpret)
+
+    def fn(reads_, mu_, z_, phi_, eidx_, ew_, lamb_):
+        return enum_loglik_fused_sparse_binary(reads_, mu_, z_, phi_,
+                                               eidx_, ew_, lamb_,
+                                               spec.P, interpret)
+
+    in_specs, out_specs = fused_sparse_binary_shard_specs(mesh)
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)(
+        reads, mu, zbin_t, phi, eta_idx, eta_w, lamb)
 
 
 def _observed_bin_loglik(spec, reads, u, omega, log_pi, phi, cn_obs, rep_obs,
@@ -585,9 +738,10 @@ def log_joint(spec: PertModelSpec, params: dict, fixed: dict,
     # materialised in HBM during training; only the parameter-free
     # Dirichlet normaliser stays here (loop-invariant — XLA hoists it out
     # of the compiled while-loop)
-    fused = (not spec.step1) and spec.enum_impl in ("pallas",
-                                                    "pallas_interpret")
+    fused = (not spec.step1) and _enum_backend(spec.enum_impl) != "xla"
     sparse = spec.sparse_etas and not spec.step1
+    pi_param = (params["pi_bin_logits"] if spec.binary_pi
+                else params.get("pi_logits"))
     eta_idx = eta_w = etas_sm = None
     if sparse:
         if batch.eta_idx is None or batch.eta_w is None:
@@ -600,7 +754,7 @@ def log_joint(spec: PertModelSpec, params: dict, fixed: dict,
             # parameter-free) normaliser stays host-side — see
             # _dirichlet_pi_term for the full-form owner
             lp_pi = gammaln(spec.P + eta_w) - gammaln(1.0 + eta_w)
-            pi_like = params["pi_logits"]
+            pi_like = pi_param
         else:
             log_pi = c["log_pi"]
             lp_pi = _dirichlet_pi_term(spec.P, batch, log_pi, sparse=True)
@@ -617,7 +771,7 @@ def log_joint(spec: PertModelSpec, params: dict, fixed: dict,
         if fused:
             lp_pi = gammaln(jnp.sum(etas, axis=-1)) \
                 - jnp.sum(gammaln(etas), axis=-1)
-            pi_like = params["pi_logits"]
+            pi_like = pi_param
             # the kernel consumes etas STATE-MAJOR like pi_logits; etas is
             # fit-constant, so XLA's loop-invariant code motion hoists this
             # transpose out of the compiled training while-loop
@@ -638,10 +792,18 @@ def log_joint(spec: PertModelSpec, params: dict, fixed: dict,
                                         cn_obs, rep_obs, lamb, log_lamb,
                                         log1m_lamb)
         if fused and sparse:
+            if spec.binary_pi:
+                return _enum_bin_loglik_fused_sparse_binary(
+                    spec, reads, u, omega_, pi_, phi_, eidx_, ew_, lamb,
+                    mesh=mesh)
             return _enum_bin_loglik_fused_sparse(
                 spec, reads, u, omega_, pi_, phi_, eidx_, ew_, lamb,
                 mesh=mesh)
         if fused:
+            if spec.binary_pi:
+                return _enum_bin_loglik_fused_binary(
+                    spec, reads, u, omega_, pi_, phi_, etas_, lamb,
+                    mesh=mesh)
             return _enum_bin_loglik_fused(spec, reads, u, omega_, pi_, phi_,
                                           etas_, lamb, mesh=mesh)
         return _enum_bin_loglik(spec, reads, u, omega_, pi_, phi_, lamb,

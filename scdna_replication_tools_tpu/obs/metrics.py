@@ -351,6 +351,10 @@ class MetricsRegistry:
                 self.gauge("pert_fit_iters_per_second",
                            labels={"step": step}).set(
                     float(payload["iters_per_second"]))
+            if payload.get("wall_seconds") is not None and seg > 0:
+                self.gauge("pert_fit_ms_per_iter",
+                           labels={"step": step}).set(
+                    1000.0 * float(payload["wall_seconds"]) / seg)
         elif event == "control_decision":
             action = payload.get("action")
             if action:

@@ -82,6 +82,9 @@ def derived_metrics(summary: dict) -> dict:
         out["pert_fit_iters_total"] = fit_iters
         if fit_wall > 0:
             out["pert_iters_per_second"] = round(fit_iters / fit_wall, 2)
+        if fit_iters > 0:
+            out["pert_fit_ms_per_iter"] = round(
+                1000.0 * fit_wall / fit_iters, 3)
     phases = summary.get("phases") or {}
     if phases:
         fitlike = sum(v for k, v in phases.items()

@@ -1,0 +1,199 @@
+"""Fused Adam update for the (planes, cells, loci) pi parameter.
+
+PERF_NOTES' traffic model shows the optimizer now outweighs the model:
+after sparse etas the fused enumeration kernel moves ~55 planes/iter
+while the Adam update on ``pi_logits`` alone moves ~91 at P=13 — and
+XLA lowers the optax chain (``tx.update`` + ``apply_updates``) to one
+kLoop fusion *per output tensor* (m, v, param), so the gradient is
+streamed twice (by the m and v fusions) and the fresh m'/v' are
+re-read by the param fusion: the realised traffic is ~10 planes per
+parameter plane, not the 7-plane single-sweep minimum.
+
+This module is the single-sweep path: ONE kernel reads
+(grad, param, m, v) and writes (param', m', v') — every operand
+streamed exactly once, 7P planes total (the true minimum), dropping to
+5P plane-equivalents when the moments are stored in bfloat16
+(``PertConfig.optimizer_state_dtype='bfloat16'``; the arithmetic stays
+float32 — only the *stored* m/v halve).
+
+Three implementations behind :func:`resolve_fused_adam`:
+
+* ``'pallas'`` — the TPU kernel (``'pallas_interpret'`` runs the same
+  body through the Pallas interpreter on CPU, the parity-test path);
+* ``'xla'`` — the same math as plain jnp ops in one jitted region (the
+  fallback for non-TPU accelerators, and the only implementation that
+  supports bfloat16 moments everywhere);
+* ``'off'`` — the caller keeps the stock optax update (the CPU 'auto'
+  resolution: there is no HBM roofline to beat on host memory, and the
+  optax path is the reference-parity trajectory).
+
+The math replicates ``optax.scale_by_adam`` + ``scale(-lr)`` term for
+term and in the same operation order (moment EMA as
+``(1-b) * g + b * m``, bias correction by division, ``eps`` added
+OUTSIDE the sqrt, update scaled by ``-lr`` then added), so the XLA
+implementation reproduces the optax trajectory exactly at float32 and
+the Pallas kernel differs only by fusion-level rounding.  Checkpoint
+compatibility is preserved by construction: the caller (infer/svi.py)
+keeps the optax state *pytree* and only swaps how its leaves are
+computed.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# optax.adam defaults (the repo never overrides them)
+ADAM_EPS = 1e-8
+
+# lane/sublane tiling of the update sweep: 512 lanes amortise control
+# overhead like the enumeration kernels; 16 sublanes (not the enum
+# kernels' 8) so a bfloat16 moment tile is still a native (16, 128*k)
+# Mosaic tile — f32 is happy with either
+TILE_C = 16
+TILE_L = 512
+
+_VALID_IMPLS = ("auto", "off", "xla", "pallas", "pallas_interpret")
+
+
+def resolve_fused_adam(impl: str = "auto") -> str:
+    """Resolve the configured fused-Adam implementation.
+
+    'auto' picks the Pallas kernel on TPU and 'off' (stock optax)
+    elsewhere — on host memory there is no bandwidth roofline to beat
+    and the optax chain is the reference-parity trajectory.  Mirrors
+    ``ops.enum_kernel.resolve_enum_impl`` so the two fused paths follow
+    one policy shape.
+    """
+    if impl not in _VALID_IMPLS:
+        raise ValueError(f"unknown fused_adam {impl!r}; expected one of "
+                         f"{_VALID_IMPLS}")
+    if impl != "auto":
+        return impl
+    from scdna_replication_tools_tpu.ops.enum_kernel import is_tpu_backend
+
+    return "pallas" if is_tpu_backend() else "off"
+
+
+def moment_jnp_dtype(moment_dtype: str):
+    """jnp dtype of the stored Adam moments ('float32'/'bfloat16')."""
+    if moment_dtype == "float32":
+        return jnp.float32
+    if moment_dtype == "bfloat16":
+        return jnp.bfloat16
+    raise ValueError(f"unknown optimizer_state_dtype {moment_dtype!r}; "
+                     "expected 'float32' or 'bfloat16'")
+
+
+def _bias_corrections(count, b1: float, b2: float):
+    """(1 - b1^t, 1 - b2^t) at the INCREMENTED count — the same
+    ``1 - decay**count`` optax's bias_correction computes."""
+    c = count.astype(jnp.float32)
+    return 1.0 - jnp.float32(b1) ** c, 1.0 - jnp.float32(b2) ** c
+
+
+def adam_update_xla(param, grad, m, v, lr, b1: float, b2: float, count,
+                    moment_dtype: str = "float32"):
+    """One fused Adam sweep as jnp ops: ``(param', m', v')``.
+
+    Replicates optax.scale_by_adam + scale(-lr) in operation order, so
+    at float32 moments the resulting trajectory is the optax
+    trajectory.  Moments arrive in ``moment_dtype`` storage, are
+    widened to float32 for the arithmetic, and the fresh moments are
+    narrowed back on the way out — the parameter update always uses
+    the full-precision moment values of THIS step.
+    """
+    dt = moment_jnp_dtype(moment_dtype)
+    g = grad.astype(jnp.float32)
+    m_f = (1.0 - b1) * g + b1 * m.astype(jnp.float32)
+    v_f = (1.0 - b2) * (g * g) + b2 * v.astype(jnp.float32)
+    bc1, bc2 = _bias_corrections(count, b1, b2)
+    update = (m_f / bc1) / (jnp.sqrt(v_f / bc2) + ADAM_EPS)
+    new_param = param + (-lr) * update
+    return new_param, m_f.astype(dt), v_f.astype(dt)
+
+
+def _adam_kernel(scal_ref, param_ref, grad_ref, m_ref, v_ref,
+                 param_out_ref, m_out_ref, v_out_ref, *, b1, b2):
+    """The single-sweep Pallas body: every ref is one (planes, tc, tl)
+    block; lr and the bias corrections ride in SMEM (they are traced
+    scalars — the chunk driver's lr is dynamic and the corrections
+    depend on the iteration count)."""
+    lr = scal_ref[0, 0]
+    bc1 = scal_ref[0, 1]
+    bc2 = scal_ref[0, 2]
+    g = grad_ref[...]
+    m = (1.0 - b1) * g + b1 * m_ref[...].astype(jnp.float32)
+    v = (1.0 - b2) * (g * g) + b2 * v_ref[...].astype(jnp.float32)
+    m_out_ref[...] = m.astype(m_out_ref.dtype)
+    v_out_ref[...] = v.astype(v_out_ref.dtype)
+    update = (m / bc1) / (jnp.sqrt(v / bc2) + ADAM_EPS)
+    param_out_ref[...] = param_ref[...] + (-lr) * update
+
+
+def adam_update_pallas(param, grad, m, v, lr, b1: float, b2: float, count,
+                       moment_dtype: str = "float32",
+                       interpret: bool = False):
+    """Single-sweep Pallas Adam for a (planes, cells, loci) parameter.
+
+    Zero-padding the tail tiles is safe: a padded element has g = 0 and
+    m = v = 0, so its update is exactly 0 and the padded region is
+    sliced away regardless.
+    """
+    from scdna_replication_tools_tpu.ops.enum_kernel import _pad2
+
+    dt = moment_jnp_dtype(moment_dtype)
+    if param.ndim != 3:
+        raise ValueError("adam_update_pallas expects a (planes, cells, "
+                         f"loci) parameter; got shape {param.shape}")
+    Pn, C, L = param.shape
+    bc1, bc2 = _bias_corrections(count, b1, b2)
+    scal = jnp.stack([jnp.asarray(lr, jnp.float32).reshape(()),
+                      bc1.reshape(()), bc2.reshape(())]).reshape(1, 3)
+    # enum_kernel._pad2 pads the trailing (cells, loci) axes of any-rank
+    # tensors — the one tile-padding helper, shared
+    param_p = _pad2(param, TILE_C, TILE_L, 0.0)
+    grad_p = _pad2(grad, TILE_C, TILE_L, 0.0)
+    m_p = _pad2(m, TILE_C, TILE_L, 0.0)
+    v_p = _pad2(v, TILE_C, TILE_L, 0.0)
+    nc, nl = param_p.shape[-2:]
+
+    block = pl.BlockSpec((Pn, TILE_C, TILE_L), lambda i, j: (0, i, j))
+    scal_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+    grid = (nc // TILE_C, nl // TILE_L)
+    new_param, new_m, new_v = pl.pallas_call(
+        functools.partial(_adam_kernel, b1=float(b1), b2=float(b2)),
+        grid=grid,
+        in_specs=[scal_spec, block, block, block, block],
+        out_specs=[block, block, block],
+        out_shape=[
+            jax.ShapeDtypeStruct((Pn, nc, nl), jnp.float32),
+            jax.ShapeDtypeStruct((Pn, nc, nl), dt),
+            jax.ShapeDtypeStruct((Pn, nc, nl), dt),
+        ],
+        interpret=interpret,
+    )(scal, param_p, grad_p, m_p, v_p)
+    if (nc, nl) != (C, L):
+        new_param = new_param[:, :C, :L]
+        new_m = new_m[:, :C, :L]
+        new_v = new_v[:, :C, :L]
+    return new_param, new_m, new_v
+
+
+def adam_plane_update(param, grad, m, v, lr, b1: float, b2: float, count,
+                      impl: str, moment_dtype: str = "float32"):
+    """Dispatch one parameter's fused Adam sweep to the selected
+    implementation.  ``impl`` must already be resolved ('xla' /
+    'pallas' / 'pallas_interpret')."""
+    if impl == "xla":
+        return adam_update_xla(param, grad, m, v, lr, b1, b2, count,
+                               moment_dtype=moment_dtype)
+    if impl in ("pallas", "pallas_interpret"):
+        return adam_update_pallas(param, grad, m, v, lr, b1, b2, count,
+                                  moment_dtype=moment_dtype,
+                                  interpret=impl == "pallas_interpret")
+    raise ValueError(f"unresolved fused_adam impl {impl!r}")

@@ -1,0 +1,474 @@
+"""Parity tests for the independent-binary CN encoding + fused Adam.
+
+The binary encoding (``enum_impl='binary'``, arXiv 2206.00093)
+reparameterises the P-way categorical ``pi_logits`` as Kb = ceil(log2 P)
+independent binary logit planes masked to the valid states, shrinking
+every O(P) per-iteration HBM stream (pi in, dpi out, Adam state) to
+O(log P) — see PERF_NOTES' planes table (146 -> 56 at P = 13).  It is a
+DIFFERENT variational family, so parity is gated the way sparse etas
+was, at three levels:
+
+* kernel: the fused binary Pallas kernels against an XLA transcription
+  of the same masked-softmax objective (value + every gradient), and
+  the sparse-vs-dense binary variants against each other;
+* model loss: ``binary_interpret`` (the kernel) against ``binary_xla``
+  (the fallback) — same encoding, different backend, tight agreement;
+* runner: a full simulate-and-recover run under ``binary_xla`` must
+  match the dense arm's accuracy (tau truth-correlation, CN accuracy,
+  qc_pass counts) within tolerance.
+
+The fused single-sweep Adam path (ops/adam_kernel.py) and the bfloat16
+moment storage ride along: the XLA implementation must reproduce the
+optax trajectory BIT-exactly at float32, the Pallas kernel to rounding,
+bfloat16 moments within a bounded divergence, and the dtype-aware
+checkpoint contract must round-trip bfloat16 bit-exactly while REFUSING
+a mid-budget resume across moment dtypes.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from scdna_replication_tools_tpu.layout import state_major
+from scdna_replication_tools_tpu.models.pert import (
+    PertBatch,
+    PertModelSpec,
+    binary_log_pi,
+    init_params,
+    pert_loss,
+)
+from scdna_replication_tools_tpu.models.priors import sparsify_etas
+from scdna_replication_tools_tpu.ops.enum_kernel import (
+    binary_code_matrix,
+    binary_code_width,
+    enum_loglik_fused_binary,
+    enum_loglik_fused_sparse_binary,
+    planes_per_iter,
+    resolve_enum_impl,
+)
+from scdna_replication_tools_tpu.ops.gc import gc_features
+
+P = 13
+
+
+# ---------------------------------------------------------------------------
+# encoding basics
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("P_", [2, 3, 7, 13, 16, 20])
+def test_binary_code_matrix_is_injective(P_):
+    """Every valid state must map to a distinct bit code of width
+    ceil(log2 P) — the masked softmax is over exactly these rows."""
+    B = binary_code_matrix(P_)
+    Kb = binary_code_width(P_)
+    assert B.shape == (P_, Kb)
+    codes = {tuple(row) for row in B.astype(int).tolist()}
+    assert len(codes) == P_
+    # row s IS the binary expansion of s
+    for s in range(P_):
+        assert int(sum(B[s, k] * 2 ** k for k in range(Kb))) == s
+
+
+def test_resolve_enum_impl_binary_values():
+    assert resolve_enum_impl("binary_xla") == "binary_xla"
+    assert resolve_enum_impl("binary") in ("binary_xla", "binary_pallas")
+    with pytest.raises(ValueError, match="enum_impl"):
+        resolve_enum_impl("binary_nope")
+
+
+def test_planes_model_matches_perf_notes_table():
+    """The analytic traffic model is the PERF_NOTES table as code: the
+    committed accounting numbers must never drift from the gauge the
+    fleet regression gate holds."""
+    assert planes_per_iter(13, binary=False, sparse_etas=True) == 146
+    assert planes_per_iter(13, binary=True, sparse_etas=True) == 56
+    assert planes_per_iter(13, binary=True, sparse_etas=True,
+                           moment_dtype="bfloat16") == 48
+    # the pre-sparse-etas historical figure: kernel 77 + adam 91
+    assert planes_per_iter(13, binary=False, sparse_etas=False) == 168
+
+
+# ---------------------------------------------------------------------------
+# kernel-level parity
+# ---------------------------------------------------------------------------
+
+def _problem(C=8, L=96, seed=7, weight=1e5):
+    rng = np.random.default_rng(seed)
+    Kb = binary_code_width(P)
+    reads = jnp.asarray(rng.poisson(40, (C, L)).astype(np.float32))
+    mu = jnp.asarray(rng.uniform(2, 30, (C, L)).astype(np.float32))
+    z = jnp.asarray(rng.normal(0, 1.5, (C, L, Kb)).astype(np.float32))
+    phi = jnp.asarray(rng.uniform(0.01, 0.99, (C, L)).astype(np.float32))
+    etas = np.ones((C, L, P), np.float32)
+    states = rng.integers(0, P, (C, L))
+    np.put_along_axis(etas, states[..., None], weight, axis=-1)
+    idx, w = sparsify_etas(etas)
+    ct = jnp.asarray(rng.normal(0, 1, (C, L)), jnp.float32)
+    return (reads, mu, z, phi, jnp.asarray(etas), jnp.asarray(idx),
+            jnp.asarray(w), jnp.float32(0.75), ct)
+
+
+def _binary_xla_oracle(reads, mu, z, phi, etas, lamb):
+    """XLA transcription of the fused binary objective: expand the Kb
+    planes through the bit matrix, masked log-softmax over the P valid
+    states, dense enumerated NB likelihood + Dirichlet data term."""
+    from jax.scipy.special import gammaln, logsumexp
+
+    B = jnp.asarray(binary_code_matrix(P))
+    log_pi = jax.nn.log_softmax(jnp.einsum("clk,pk->clp", z, B), -1)
+    chi = jnp.arange(P, dtype=jnp.float32)[:, None] * \
+        (1.0 + jnp.arange(2, dtype=jnp.float32))[None, :]
+    delta = jnp.maximum(mu[..., None, None] * chi * (1 - lamb) / lamb, 1.0)
+    nb = (gammaln(reads[..., None, None] + delta) - gammaln(delta)
+          - gammaln(reads[..., None, None] + 1.0)
+          + delta * jnp.log1p(-lamb)
+          + reads[..., None, None] * jnp.log(lamb))
+    bern = jnp.stack([jnp.log1p(-phi), jnp.log(phi)], -1)
+    joint = log_pi[..., :, None] + bern[..., None, :] + nb
+    return logsumexp(joint, axis=(-2, -1)) \
+        + jnp.sum((etas - 1.0) * log_pi, axis=-1)
+
+
+@pytest.mark.parametrize("etas_kind", ["random_small", "concentrated_1e5"])
+def test_binary_kernel_matches_xla_oracle(etas_kind):
+    """Value + all three gradients of the fused binary kernel against
+    jax.grad through the XLA oracle — including the chained
+    softmax-Jacobian + bit-expansion backward (dz)."""
+    reads, mu, z, phi, etas, _, _, lamb, ct = _problem()
+    if etas_kind == "random_small":
+        rng = np.random.default_rng(11)
+        etas = jnp.asarray(rng.uniform(0.3, 5.0, etas.shape)
+                           .astype(np.float32))
+
+    def oracle(mu, z, phi):
+        return jnp.sum(_binary_xla_oracle(reads, mu, z, phi, etas, lamb)
+                       * ct)
+
+    def kernel(mu, z, phi):
+        return jnp.sum(enum_loglik_fused_binary(
+            reads, mu, state_major(z), phi, state_major(etas), lamb, P,
+            True) * ct)
+
+    v_ref, g_ref = jax.value_and_grad(oracle, (0, 1, 2))(mu, z, phi)
+    v_pal, g_pal = jax.value_and_grad(kernel, (0, 1, 2))(mu, z, phi)
+    assert abs(float(v_ref - v_pal)) / (abs(float(v_ref)) + 1e-30) < 1e-4
+    for name, a, b in zip(("dmu", "dz", "dphi"), g_ref, g_pal):
+        rel = float(jnp.max(jnp.abs(a - b))
+                    / (jnp.max(jnp.abs(a)) + 1e-30))
+        assert rel < 2e-2, (name, rel)
+
+
+def test_sparse_binary_kernel_matches_dense_binary_kernel():
+    """The sparse-etas binary variant must equal the dense binary one
+    (value AND gradients) on a one-hot prior — same math, compact
+    Dirichlet encoding (mirrors test_sparse_etas's kernel gate)."""
+    reads, mu, z, phi, etas, idx, w, lamb, ct = _problem()
+
+    def dense(z):
+        return jnp.sum(enum_loglik_fused_binary(
+            reads, mu, state_major(z), phi, state_major(etas), lamb, P,
+            True) * ct)
+
+    def sparse(z):
+        return jnp.sum(enum_loglik_fused_sparse_binary(
+            reads, mu, state_major(z), phi, idx, w, lamb, P, True) * ct)
+
+    vd, gd = jax.value_and_grad(dense)(z)
+    vs, gs = jax.value_and_grad(sparse)(z)
+    assert abs(float(vd - vs)) / abs(float(vd)) < 1e-5
+    rel = float(jnp.max(jnp.abs(gd - gs)) / (jnp.max(jnp.abs(gd)) + 1e-30))
+    assert rel < 1e-4, rel
+
+
+def test_binary_kernel_rejects_bad_shapes():
+    reads, mu, z, phi, etas, idx, w, lamb, _ = _problem()
+    with pytest.raises(ValueError, match="Kb"):
+        # cells-major z (the layout bug class the categorical kernels
+        # also reject loudly)
+        enum_loglik_fused_binary(reads, mu, z, phi, state_major(etas),
+                                 lamb, P, True)
+    with pytest.raises(ValueError, match="Kb"):
+        enum_loglik_fused_sparse_binary(reads, mu, z, phi, idx, w, lamb,
+                                        P, True)
+
+
+# ---------------------------------------------------------------------------
+# model-loss-level parity
+# ---------------------------------------------------------------------------
+
+def _model_problem(weight=1e5):
+    rng = np.random.default_rng(5)
+    C, L = 12, 200
+    reads = rng.poisson(40, (C, L)).astype(np.float32)
+    gammas = rng.uniform(0.35, 0.6, L).astype(np.float32)
+    etas = np.ones((C, L, P), np.float32)
+    states = rng.integers(1, 5, (C, L))
+    np.put_along_axis(etas, states[..., None], weight, axis=-1)
+    idx, w = sparsify_etas(etas)
+    common = dict(
+        reads=jnp.asarray(reads), libs=jnp.zeros((C,), jnp.int32),
+        gamma_feats=gc_features(jnp.asarray(gammas), 4),
+        mask=jnp.ones((C,), jnp.float32))
+    sparse_batch = PertBatch(eta_idx=jnp.asarray(idx),
+                             eta_w=jnp.asarray(w), **common)
+    fixed = {"beta_means": jnp.zeros((1, 5), jnp.float32),
+             "lamb": jnp.asarray(0.75, jnp.float32)}
+    return sparse_batch, fixed, np.full(C, 0.4, np.float32), states
+
+
+def test_pert_loss_binary_kernel_matches_binary_xla():
+    """Full model loss + gradients: the binary kernel backend vs the
+    binary XLA fallback — SAME encoding (identical pi_bin_logits
+    parameterisation), so agreement is at kernel-accuracy level."""
+    batch, fixed, t_init, _ = _model_problem()
+    out = {}
+    for impl in ("binary_xla", "binary_interpret"):
+        spec = PertModelSpec(P=P, K=4, L=1, tau_mode="param",
+                             cond_beta_means=True, fixed_lamb=True,
+                             sparse_etas=True, enum_impl=impl)
+        params = init_params(spec, batch, fixed, t_init=t_init)
+        assert "pi_bin_logits" in params and "pi_logits" not in params
+        assert params["pi_bin_logits"].shape == \
+            (binary_code_width(P),) + batch.reads.shape
+        out[impl] = jax.value_and_grad(
+            lambda p: pert_loss(spec, p, fixed, batch))(params)
+    (va, ga), (vb, gb) = out["binary_xla"], out["binary_interpret"]
+    assert abs(float(va - vb)) / abs(float(va)) < 5e-4
+    for k in ga:
+        denom = float(jnp.max(jnp.abs(ga[k]))) + 1e-20
+        assert float(jnp.max(jnp.abs(ga[k] - gb[k]))) / denom < 2e-2, k
+
+
+def test_binary_init_targets_the_prior_mode():
+    """The one-hot-prior init must put each bin's masked-softmax argmax
+    at the prior state (the binary family cannot represent the dense
+    init's exact simplex point; the MODE is the contract)."""
+    batch, fixed, t_init, states = _model_problem()
+    spec = PertModelSpec(P=P, K=4, L=1, tau_mode="param",
+                         cond_beta_means=True, fixed_lamb=True,
+                         sparse_etas=True, enum_impl="binary_xla")
+    params = init_params(spec, batch, fixed, t_init=t_init)
+    log_pi = binary_log_pi(spec, params["pi_bin_logits"])
+    np.testing.assert_array_equal(
+        np.asarray(jnp.argmax(log_pi, -1)), states)
+    # and the mode carries essentially all the mass under the 1e5 prior
+    assert float(jnp.exp(jnp.max(log_pi, -1)).min()) > 0.99
+
+
+# ---------------------------------------------------------------------------
+# fused Adam + bfloat16 moments
+# ---------------------------------------------------------------------------
+
+def _fit_problem():
+    rng = np.random.default_rng(0)
+    pi = rng.normal(0, 1, (13, 24, 300)).astype(np.float32)
+    tau = rng.normal(0, 1, (24,)).astype(np.float32)
+
+    def fresh():
+        return {"pi_logits": jnp.asarray(pi), "tau_raw": jnp.asarray(tau)}
+
+    def loss(p):
+        return jnp.sum(jnp.sin(p["pi_logits"])) * 1e-3 \
+            + jnp.sum(p["tau_raw"] ** 2)
+
+    return fresh, loss
+
+
+def test_fused_adam_xla_reproduces_optax_bit_exactly():
+    """The fused single-sweep update (XLA impl) replicates
+    optax.scale_by_adam + scale(-lr) in operation order — the full
+    compiled-fit trajectory must be BIT-identical, which is what lets
+    'auto' ship without perturbing any reference-parity test."""
+    from scdna_replication_tools_tpu.infer.svi import fit_map
+
+    fresh, loss = _fit_problem()
+    kw = dict(max_iter=25, min_iter=25, rel_tol=0.0, diag_every=0)
+    base = fit_map(loss, fresh(), **kw)
+    fused = fit_map(loss, fresh(), fused_adam="xla", **kw)
+    np.testing.assert_array_equal(base.losses, fused.losses)
+    for k in base.params:
+        np.testing.assert_array_equal(np.asarray(base.params[k]),
+                                      np.asarray(fused.params[k]))
+
+
+def test_fused_adam_pallas_matches_xla():
+    """The Pallas Adam kernel (interpret mode on CPU: identical body)
+    agrees with the XLA implementation to float32 rounding."""
+    from scdna_replication_tools_tpu.infer.svi import fit_map
+
+    fresh, loss = _fit_problem()
+    kw = dict(max_iter=25, min_iter=25, rel_tol=0.0, diag_every=0)
+    x = fit_map(loss, fresh(), fused_adam="xla", **kw)
+    p = fit_map(loss, fresh(), fused_adam="pallas_interpret", **kw)
+    np.testing.assert_allclose(x.losses, p.losses, rtol=1e-5, atol=1e-5)
+
+
+def test_bf16_moments_trajectory_divergence_is_bounded():
+    """bfloat16 moment storage changes the trajectory (that is the
+    documented trade) but must stay CLOSE to the float32 one over a
+    real optimisation segment — a blow-up here would mean the
+    arithmetic (not just the storage) lost precision."""
+    from scdna_replication_tools_tpu.infer.svi import fit_map
+
+    fresh, loss = _fit_problem()
+    kw = dict(max_iter=60, min_iter=60, rel_tol=0.0, diag_every=0)
+    f32 = fit_map(loss, fresh(), fused_adam="xla", **kw)
+    bf16 = fit_map(loss, fresh(), fused_adam="xla",
+                   moment_dtype="bfloat16", **kw)
+    assert bf16.opt_state[0].mu["pi_logits"].dtype == jnp.bfloat16
+    # small params (not the pi plane) keep float32 moments
+    assert bf16.opt_state[0].mu["tau_raw"].dtype == jnp.float32
+    denom = abs(float(f32.losses[0] - f32.losses[-1])) + 1e-30
+    rel = np.max(np.abs(f32.losses - bf16.losses)) / denom
+    assert rel < 0.05, rel
+
+
+def test_bf16_moments_resume_is_bit_exact():
+    """A bfloat16-moment fit interrupted mid-budget and resumed from
+    its (params, opt_state, loss prefix) must reproduce the
+    uninterrupted trajectory bit-exactly — the same contract the f32
+    path pins in test_donation."""
+    from scdna_replication_tools_tpu.infer.svi import fit_map
+
+    fresh, loss = _fit_problem()
+    kw = dict(rel_tol=0.0, diag_every=0, fused_adam="xla",
+              moment_dtype="bfloat16")
+    full = fit_map(loss, fresh(), max_iter=40, min_iter=40, **kw)
+    part = fit_map(loss, fresh(), max_iter=20, min_iter=20, **kw)
+    resumed = fit_map(loss, part.params, max_iter=40, min_iter=40,
+                      opt_state0=part.opt_state,
+                      losses_prefix=part.losses, **kw)
+    np.testing.assert_array_equal(full.losses, resumed.losses)
+    for k in full.params:
+        np.testing.assert_array_equal(np.asarray(full.params[k]),
+                                      np.asarray(resumed.params[k]))
+
+
+def test_checkpoint_round_trips_bf16_moments_bit_exactly(tmp_path):
+    """save -> load of a bfloat16-moment optimizer state preserves the
+    exact bits (uint16-view storage; npz cannot hold ml_dtypes
+    natively) and records the moment dtype in the meta block."""
+    from scdna_replication_tools_tpu.infer import checkpoint as ckpt
+    from scdna_replication_tools_tpu.infer.svi import fit_map
+
+    fresh, loss = _fit_problem()
+    fit = fit_map(loss, fresh(), max_iter=10, min_iter=10, rel_tol=0.0,
+                  diag_every=0, fused_adam="xla",
+                  moment_dtype="bfloat16")
+    params_np = jax.tree_util.tree_map(np.asarray, fit.params)
+    opt_np = jax.tree_util.tree_map(np.asarray, fit.opt_state)
+    ckpt.save_step(str(tmp_path), "step2", params_np, fit.losses,
+                   opt_state=opt_np, num_iters=fit.num_iters,
+                   converged=False)
+    params, losses, extra = ckpt.load_step(str(tmp_path), "step2")
+    assert str(extra["meta.opt_moment_dtype"]) == "bfloat16"
+    restored = ckpt.restore_opt_state(extra, params, 0.05, 0.8, 0.99)
+    ref_leaves = jax.tree_util.tree_leaves(opt_np)
+    got_leaves = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(np.asarray, restored))
+    assert len(ref_leaves) == len(got_leaves)
+    for a, b in zip(ref_leaves, got_leaves):
+        assert a.dtype == b.dtype, (a.dtype, b.dtype)
+        np.testing.assert_array_equal(
+            a.view(np.uint16) if a.dtype.name == "bfloat16" else a,
+            b.view(np.uint16) if b.dtype.name == "bfloat16" else b)
+
+
+def test_resume_refuses_moment_dtype_mismatch(tmp_path, synthetic_frames):
+    """A PARTIAL float32-moment checkpoint must refuse to resume under
+    optimizer_state_dtype='bfloat16' (the continuation cannot be
+    bit-exact) — loudly, not by silent divergence."""
+    from conftest import dense_inputs_from_frames
+    from scdna_replication_tools_tpu.config import PertConfig
+    from scdna_replication_tools_tpu.infer import checkpoint as ckpt
+    from scdna_replication_tools_tpu.infer.runner import PertInference
+    from scdna_replication_tools_tpu.infer.svi import fit_map
+
+    fresh, loss = _fit_problem()
+    fit = fit_map(loss, fresh(), max_iter=10, min_iter=10, rel_tol=0.0,
+                  diag_every=0)
+    ckpt.save_step(str(tmp_path), "step2",
+                   jax.tree_util.tree_map(np.asarray, fit.params),
+                   fit.losses,
+                   opt_state=jax.tree_util.tree_map(np.asarray,
+                                                    fit.opt_state),
+                   num_iters=fit.num_iters, converged=False)
+
+    s, g1, clone_idx = dense_inputs_from_frames(synthetic_frames)
+    config = PertConfig(checkpoint_dir=str(tmp_path), resume="force",
+                        max_iter=100, min_iter=10,
+                        optimizer_state_dtype="bfloat16",
+                        telemetry_path=None)
+    inf = PertInference(s, g1, config, clone_idx_s=clone_idx,
+                        clone_idx_g1=clone_idx, num_clones=2)
+    with pytest.raises(ValueError, match="optimizer_state_dtype"):
+        inf._load_resumable("step2", 100, None, None, None)
+
+
+# ---------------------------------------------------------------------------
+# runner-level parity (simulate and recover)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def binary_vs_dense_runs(synthetic_frames):
+    """Two full scRT runs on the same simulated workload — the sole
+    delta is enum_impl ('auto' -> categorical XLA on CPU vs
+    'binary_xla').  Module-scoped: the two pipelines are the expensive
+    part of this suite."""
+    from scdna_replication_tools_tpu.api import scRT
+    from scdna_replication_tools_tpu.models.simulator import pert_simulator
+
+    df_s, df_g = synthetic_frames
+    sim_s, sim_g = pert_simulator(
+        df_s, df_g, num_reads=50_000, rt_cols=["rt_A", "rt_B"],
+        clones=["A", "B"], lamb=0.75, betas=[0.5, 0.0], a=10.0, seed=11)
+    for df in (sim_s, sim_g):
+        df["reads"] = df["true_reads_norm"]
+        df["state"] = df["true_somatic_cn"].astype(int)
+        df["copy"] = df["true_somatic_cn"].astype(float)
+
+    out = {}
+    for name, impl in (("dense", "auto"), ("binary", "binary_xla")):
+        scrt = scRT(sim_s.copy(), sim_g.copy(), input_col="reads",
+                    clone_col="clone_id", assign_col="copy",
+                    cn_prior_method="g1_clones", max_iter=300,
+                    min_iter=100, rt_prior_col=None, run_step3=False,
+                    enum_impl=impl, seed=0)
+        cn_s_out, _, _, _ = scrt.infer(level="pert")
+        qc = scrt.cell_qc()
+        out[name] = (cn_s_out, qc)
+    return out
+
+
+def test_runner_binary_matches_dense_tau_accuracy(binary_vs_dense_runs):
+    """ISSUE 11 acceptance: binary-arm tau truth-correlation >= 0.99 of
+    the dense arm's value on the simulator workload."""
+    corr = {}
+    for name, (cn_out, _) in binary_vs_dense_runs.items():
+        per_cell = cn_out.groupby("cell_id").agg(
+            tau=("model_tau", "first"), true_t=("true_t", "first"))
+        corr[name] = float(np.corrcoef(per_cell["tau"],
+                                       per_cell["true_t"])[0, 1])
+    assert corr["dense"] > 0.8, corr
+    assert corr["binary"] >= 0.99 * corr["dense"], corr
+
+
+def test_runner_binary_matches_dense_cn_accuracy(binary_vs_dense_runs):
+    acc = {}
+    for name, (cn_out, _) in binary_vs_dense_runs.items():
+        acc[name] = float((cn_out["model_cn_state"]
+                           == cn_out["true_somatic_cn"]).mean())
+    assert acc["dense"] > 0.9, acc
+    assert acc["binary"] >= acc["dense"] - 0.02, acc
+
+
+def test_runner_binary_matches_dense_qc_pass_counts(binary_vs_dense_runs):
+    """Identical qc_pass counts within tolerance: the encoding change
+    must not shift cells across the model-health QC gates."""
+    counts = {name: int(qc["qc_pass"].sum())
+              for name, (_, qc) in binary_vs_dense_runs.items()}
+    n = len(binary_vs_dense_runs["dense"][1])
+    assert abs(counts["binary"] - counts["dense"]) <= max(1, n // 12), \
+        counts

@@ -30,7 +30,10 @@ import jax  # noqa: E402
 from tools.pertlint.deep import entrypoints, trace  # noqa: E402
 
 GOLDEN = pathlib.Path(__file__).parent / "golden" / "jaxpr_census.json"
-PROGRAMS = ("decode_slab", "fit_chunk")
+# the binary-encoding twins pin the PR-11 programs: the Kb-plane chunk
+# fit (fused kernel + single-sweep Adam) and the binary decode slab
+PROGRAMS = ("decode_slab", "fit_chunk", "decode_slab_binary",
+            "fit_chunk_binary")
 
 
 def _census(name: str) -> dict:

@@ -31,6 +31,7 @@ CANONICAL_DIMS: Dict[str, int] = {
     "loci": 16,
     "P": 13,
     "K1": 5,   # K + 1 GC-polynomial features
+    "Kb": 4,   # ceil(log2 P) binary logit planes (enum_impl='binary')
     "L": 1,
 }
 MESH_EXTENTS: Dict[str, int] = {"cells": 4, "loci": 2}
@@ -165,6 +166,88 @@ def build_decode_slab() -> EntryProgram:
                         dynamic_args=dynamic, declared_donate=())
 
 
+def _binary_model_pieces():
+    """The step-2 production shape under the independent-binary CN
+    encoding (enum_impl='binary'): sparse one-hot prior, conditioned
+    beta_means, fixed lambda — the spec the runner builds for an
+    enumerated step, with the interpreter backend so the Pallas kernel
+    traces/lowers on the CPU engine."""
+    import jax
+    import jax.numpy as jnp
+
+    from scdna_replication_tools_tpu.models.pert import (
+        PertBatch,
+        PertModelSpec,
+        init_params,
+    )
+
+    spec = PertModelSpec(P=CANONICAL_DIMS["P"], K=CANONICAL_DIMS["K1"] - 1,
+                         L=CANONICAL_DIMS["L"], tau_mode="param",
+                         cond_beta_means=True, fixed_lamb=True,
+                         sparse_etas=True, enum_impl="binary_interpret")
+    batch = PertBatch.abstract(spec, CANONICAL_DIMS["cells"],
+                               CANONICAL_DIMS["loci"])
+    S = jax.ShapeDtypeStruct
+    fixed = {"beta_means": S((CANONICAL_DIMS["L"], CANONICAL_DIMS["K1"]),
+                             jnp.float32),
+             "lamb": S((), jnp.float32)}
+    params = jax.eval_shape(functools.partial(init_params, spec), batch,
+                            fixed)
+    return spec, batch, fixed, params
+
+
+def build_fit_chunk_binary() -> EntryProgram:
+    """The controller chunk program under the binary CN encoding + the
+    fused single-sweep Adam update (XLA implementation — the Pallas
+    Adam kernel shares its math and is parity-pinned separately): the
+    pi parameter is the Kb-plane ``pi_bin_logits`` and the optimizer
+    update is one fused sweep per leaf.  Same donation contract as
+    ``fit_chunk`` (params0 deliberately kept — DP003 baseline)."""
+    import jax
+    import jax.numpy as jnp
+
+    from scdna_replication_tools_tpu.infer import svi
+
+    spec, batch, fixed, params = _binary_model_pieces()
+    opt_state = jax.eval_shape(svi.make_opt_state, params)
+    S = jax.ShapeDtypeStruct
+    losses0 = S((MAX_ITER,), jnp.float32)
+    diag0 = S((svi.DIAG_RING, 3), jnp.float32)
+    i32 = S((), jnp.int32)
+    f32 = S((), jnp.float32)
+    loss_args = (fixed, batch)
+    loss = _loss_fn(spec)
+    args = (loss, params, opt_state, losses0, diag0, i32, i32, i32, f32,
+            f32, loss_args, min(9, MAX_ITER), B1, B2, DIAG_EVERY, "xla",
+            "float32")
+    dynamic = [("params0", params), ("opt_state0", opt_state),
+               ("losses0", losses0), ("diag0", diag0), ("i0", i32),
+               ("stop", i32), ("min_iter", i32), ("rel_tol", f32),
+               ("lr", f32), ("loss_args", loss_args)]
+    return EntryProgram(name="fit_chunk_binary",
+                        anchor=svi._run_fit_chunk,
+                        jit_fn=svi._run_fit_chunk, args=args, kwargs={},
+                        dynamic_args=dynamic,
+                        declared_donate=svi.CHUNK_DONATE_ARGNAMES)
+
+
+def build_decode_slab_binary() -> EntryProgram:
+    """The decode/QC slab under the binary CN encoding: the per-state
+    log-pi tensor is expanded from the Kb planes inside the program
+    (models.pert.binary_log_pi) — pure XLA, so it traces on any
+    backend."""
+    from scdna_replication_tools_tpu.models import pert
+
+    spec, batch, fixed, params = _binary_model_pieces()
+    args = (spec, params, fixed, batch)
+    dynamic = [("params", params), ("fixed", fixed), ("batch", batch)]
+    return EntryProgram(name="decode_slab_binary",
+                        anchor=pert._decode_slab,
+                        jit_fn=pert._decode_slab, args=args,
+                        kwargs={"want_entropy": True},
+                        dynamic_args=dynamic, declared_donate=())
+
+
 def build_ppc() -> EntryProgram:
     """The posterior-predictive-check slab (``_ppc_slab``)."""
     import jax
@@ -243,7 +326,9 @@ REGISTRY: Dict[str, Callable[[], EntryProgram]] = {
     "loss": build_loss,
     "fit": build_fit,
     "fit_chunk": build_fit_chunk,
+    "fit_chunk_binary": build_fit_chunk_binary,
     "decode_slab": build_decode_slab,
+    "decode_slab_binary": build_decode_slab_binary,
     "ppc": build_ppc,
     "sharded_batch": build_sharded_batch,
     "sharded_params": build_sharded_params,

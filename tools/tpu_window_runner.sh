@@ -90,6 +90,14 @@ battery() {  # returns 0 only if every step it attempted succeeded
         python bench.py --platform tpu --budget full --iters 300 --skip-baseline || return 1
     run_one BENCH_r06_tpu_10k device_platform 1200 \
         python bench.py --platform tpu --budget full --cells 10000 --iters 50 --skip-baseline || return 1
+    # CN-encoding A/B on the chip (PR 10): dense categorical vs
+    # independent-binary vs binary + fused single-sweep Adam at the
+    # benchmark shape — the on-chip measurement PERF_NOTES' planes
+    # model predicts (~146 -> ~56 planes/iter); the committed CPU
+    # artifact is roofline-blind by nature
+    run_one BENCH_r10_enum_ab_tpu platform 1500 \
+        python bench.py --enum-ab --platform tpu --budget full \
+            --ab-out artifacts/BENCH_r10_enum_ab_tpu.json || return 1
     run_one FULL_PIPELINE_r06_rescue_tpu platform 1500 \
         python tools/full_pipeline_bench.py --run-step3 --mirror-rescue \
             --checkpoint-dir artifacts/ckpt_r06_rescue $DURABLE \
