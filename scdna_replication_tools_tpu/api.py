@@ -118,7 +118,7 @@ class scRT:
                  loci_shards=1, cell_chunk=None, checkpoint_dir=None,
                  resume='auto', checkpoint_every=4, faults=None,
                  watchdog_compile_seconds=None,
-                 watchdog_chunk_seconds=None,
+                 watchdog_chunk_seconds=None, elastic_mesh=True,
                  enum_impl='auto', fused_adam='auto',
                  optimizer_state_dtype='float32', cn_hmm_self_prob=None,
                  rho_from_rt_prior=False, mirror_rescue=True,
@@ -160,6 +160,7 @@ class scRT:
             checkpoint_every=checkpoint_every, faults=faults,
             watchdog_compile_seconds=watchdog_compile_seconds,
             watchdog_chunk_seconds=watchdog_chunk_seconds,
+            elastic_mesh=elastic_mesh,
             enum_impl=enum_impl, fused_adam=fused_adam,
             optimizer_state_dtype=optimizer_state_dtype,
             cn_hmm_self_prob=cn_hmm_self_prob,

@@ -83,9 +83,18 @@ def infer_scrt_main(argv=None):
                         "checkpoints (PertConfig.checkpoint_every)")
     p.add_argument("--faults", default=None,
                    help="deterministic fault-injection spec for chaos "
-                        "testing, e.g. 'preempt@step2/chunk#2' "
+                        "testing, e.g. 'preempt@step2/chunk#2' or the "
+                        "process-scoped 'preempt@step2/chunk#2@proc1' "
                         "(PertConfig.faults; see utils/faults.py)")
     from argparse import BooleanOptionalAction
+    p.add_argument("--elastic-mesh", action=BooleanOptionalAction,
+                   default=True,
+                   help="elastic mesh-shrink rung of the recovery "
+                        "ladder: on host/device loss or OOM in a "
+                        "sharded fit, halve the mesh's cells axis and "
+                        "continue from the last checkpoint instead of "
+                        "aborting (PertConfig.elastic_mesh; each shrink "
+                        "is audited as a 'degrade mesh_shrink' event)")
     p.add_argument("--mirror-rescue", action=BooleanOptionalAction,
                    default=True,
                    help="post-step-2 mirror-basin rescue for boundary-tau "
@@ -159,6 +168,7 @@ def infer_scrt_main(argv=None):
                 checkpoint_dir=args.checkpoint_dir, resume=args.resume,
                 checkpoint_every=args.checkpoint_every,
                 faults=args.faults,
+                elastic_mesh=args.elastic_mesh,
                 mirror_rescue=args.mirror_rescue,
                 compile_cache_dir=args.compile_cache,
                 telemetry_path=args.telemetry,

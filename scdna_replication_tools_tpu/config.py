@@ -133,6 +133,21 @@ class PertConfig:
     # local backends; the TPU window runner sets them.
     watchdog_compile_seconds: Optional[float] = None
     watchdog_chunk_seconds: Optional[float] = None
+    # elastic mesh-shrink rung of the recovery ladder (default ON): on
+    # a host/device loss or REPEATED OOM escaping a SHARDED fit (the
+    # first OOM gets one same-mesh re-entry — shrinking raises
+    # per-device load, so only a recurring OOM walks the ladder),
+    # rebuild the
+    # mesh at half the cells extent (ultimately one device), re-place
+    # the last checkpoint through the normal resume path, and continue
+    # — each shrink audited as a `degrade mesh_shrink` RunLog event
+    # with before/after topology (pert_mesh_shrinks_total).  Applies
+    # to single-process multi-device meshes; a multi-HOST window
+    # change instead rides the topology-portable checkpoints: preempt,
+    # then --resume auto on whatever shape the next window offers.
+    # False aborts with the resumable artifact on the first failure
+    # (the pre-elastic behaviour).
+    elastic_mesh: bool = True
     # enumerated-likelihood implementation: 'auto' picks the fused Pallas
     # kernel (ops/enum_kernel.py) on TPU (shard_map'd per device when a
     # mesh is active) and the XLA broadcast path elsewhere; 'xla' /

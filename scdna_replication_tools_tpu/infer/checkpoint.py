@@ -41,6 +41,7 @@ Resume semantics (see ``runner.PertInference._fit``):
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 import struct
 from typing import Optional
@@ -51,6 +52,15 @@ from scdna_replication_tools_tpu.infer.manifest import atomic_write_bytes
 from scdna_replication_tools_tpu.utils.profiling import logger
 
 # Format history (the pi_logits layout contract lives in layout.py):
+#   v4  topology stamp (meta.topology: mesh axes/extents, process
+#       count/index, device kind, per-leaf PartitionSpecs from
+#       layout.param_layouts) embedded in every save; multi-process
+#       saves write one HOST-LOCAL shard file per process plus a
+#       process-0 commit pointer (two-phase commit — see save_step);
+#       per-leaf `range.`/`gshape.` sidecars record each block's
+#       global box so a checkpoint written on ANY topology reassembles
+#       on any other.  v3 files load unchanged (no stamp = legacy
+#       single-device topology).
 #   v3  integrity footer appended; optional ctrl.* / best.* extras
 #       (controller resume state) — fully readable by the v2 loader
 #       layout-wise, so no layout bump
@@ -60,7 +70,7 @@ from scdna_replication_tools_tpu.utils.profiling import logger
 #       so an unstamped 3-D pi_logits is AMBIGUOUS and load_step refuses
 #       it rather than guessing (a wrong guess trains on a transposed
 #       tensor); delete the stale .npz and refit.
-CHECKPOINT_FORMAT_VERSION = 3
+CHECKPOINT_FORMAT_VERSION = 4
 
 # integrity footer: magic(8) + little-endian payload length(8) + sha256(32)
 _FOOTER_MAGIC = b"PERTCK01"
@@ -89,50 +99,134 @@ def _prev_path(path: str) -> str:
     return f"{root}.prev{ext}"
 
 
-def save_step(checkpoint_dir: str, step: str, params: dict,
-              losses: np.ndarray, extra: Optional[dict] = None,
-              opt_state=None, num_iters: Optional[int] = None,
-              converged: bool = True, nan_abort: bool = False) -> str:
-    os.makedirs(checkpoint_dir, exist_ok=True)
-    path = _step_path(checkpoint_dir, step)
-    flat = {f"param.{k}": np.asarray(v) for k, v in params.items()}
-    flat["losses"] = np.asarray(losses)
-    # v3 = state-major pi_logits (see layout.py) + integrity footer
-    flat["meta.format_version"] = np.asarray(CHECKPOINT_FORMAT_VERSION)
-    flat["meta.num_iters"] = np.asarray(
-        num_iters if num_iters is not None else len(losses))
-    flat["meta.converged"] = np.asarray(bool(converged))
-    flat["meta.nan_abort"] = np.asarray(bool(nan_abort))
-    if opt_state is not None:
-        # flatten generically; the reader rebuilds the treedef from a
-        # fresh optax init over the restored params (same structure).
-        # Dtype-aware (optimizer_state_dtype='bfloat16'): numpy's npz
-        # container cannot round-trip ml_dtypes.bfloat16 (it reloads as
-        # a void dtype), so bfloat16 leaves are stored as uint16 BIT
-        # VIEWS with a per-leaf ``optdtype.N`` sidecar that the loader
-        # uses to view them back — bit-exact both ways.  The summary
-        # ``meta.opt_moment_dtype`` is what the runner's resume gate
-        # compares against the configured dtype.
-        import jax
-        leaves = jax.tree_util.tree_leaves(opt_state)
-        moment_dtype = "float32"
-        for i, leaf in enumerate(leaves):
-            arr = np.asarray(leaf)
-            if arr.dtype.name == "bfloat16":
-                flat[f"opt.{i}"] = arr.view(np.uint16)
-                flat[f"optdtype.{i}"] = np.asarray("bfloat16")
-                moment_dtype = "bfloat16"
-            else:
-                flat[f"opt.{i}"] = arr
-        flat["meta.opt_moment_dtype"] = np.asarray(moment_dtype)
-    for k, v in (extra or {}).items():
-        flat[f"extra.{k}"] = np.asarray(v)
+def _commit_path(checkpoint_dir: str, step: str) -> str:
+    return os.path.join(checkpoint_dir, f"pert_{step}.commit.json")
 
-    # serialize to memory so the integrity footer hashes exactly the
-    # bytes that land on disk, then commit atomically with retention:
-    # rotate the previous good file aside BEFORE replacing it, so a
-    # corrupt new file (partial write + crash, or the injected
-    # corruption fault) always leaves a fallback
+
+def _shard_path(checkpoint_dir: str, step: str, seq: int, k: int,
+                n: int) -> str:
+    return os.path.join(checkpoint_dir,
+                        f"pert_{step}.s{seq}.p{k}of{n}.npz")
+
+
+# ---------------------------------------------------------------------------
+# topology stamp + host-local views
+# ---------------------------------------------------------------------------
+
+
+def topology_stamp(mesh=None) -> dict:
+    """JSON-able record of the save-time execution topology.
+
+    Embedded in every checkpoint (``meta.topology``) so a resuming
+    process can tell bit-exact same-geometry restores apart from
+    cross-topology (resharding) resumes: mesh axis names/extents,
+    process count/index, device count/kind, and the PartitionSpec +
+    cells-axis of every parameter leaf from ``layout.param_layouts``
+    (the same table the DP006/DP007 contract checker enumerates).
+    """
+    from scdna_replication_tools_tpu import layout
+    from scdna_replication_tools_tpu.parallel.distributed import (
+        process_topology,
+    )
+    from scdna_replication_tools_tpu.parallel.mesh import loci_axis
+
+    stamp = {"format": 1}
+    stamp.update(process_topology(mesh))
+    lx = loci_axis(mesh) if mesh is not None else None
+    stamp["param_layouts"] = layout.param_layouts(lx)
+    return stamp
+
+
+def host_view(tree):
+    """Host-transferable view of a pytree for the checkpoint writer.
+
+    Fully-addressable leaves (single-process, or replicated on one
+    host's devices) become numpy; multi-host global jax.Arrays pass
+    through UNCHANGED — :func:`save_step` gathers their addressable
+    shards into this host's block.  Call sites that used to
+    ``tree_map(np.asarray, ...)`` route through this instead, because
+    ``np.asarray`` on a non-fully-addressable array raises.
+    """
+    import jax
+
+    def one(leaf):
+        if leaf is None:
+            return None
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            return leaf
+        return np.asarray(leaf)
+
+    return jax.tree_util.tree_map(one, tree)
+
+
+def _host_block(leaf):
+    """(host-local numpy block, global box or None) of one leaf.
+
+    For plain numpy / fully-addressable arrays the block IS the whole
+    array (box None).  For a multi-host global array, the addressable
+    shards are assembled into the bounding box of this host's region —
+    per-host contiguous by the ``HostShard`` tiling contract — and the
+    box ``((lo0, hi0), ...)`` records where the block sits in the
+    global array, which is all the loader needs to reassemble on ANY
+    topology.
+    """
+    import jax
+
+    if not isinstance(leaf, jax.Array) or leaf.is_fully_addressable:
+        return np.asarray(leaf), None
+    shards = list(leaf.addressable_shards)
+    shape = leaf.shape
+    ndim = len(shape)
+    los = list(shape)
+    his = [0] * ndim
+    boxes = []
+    for s in shards:
+        box = []
+        for d, sl in enumerate(s.index):
+            start = 0 if sl.start is None else int(sl.start)
+            stop = shape[d] if sl.stop is None else int(sl.stop)
+            box.append((start, stop))
+            los[d] = min(los[d], start)
+            his[d] = max(his[d], stop)
+        boxes.append(tuple(box))
+    block = np.zeros([hi - lo for lo, hi in zip(los, his)],
+                     np.asarray(shards[0].data).dtype)
+    for s, box in zip(shards, boxes):
+        target = tuple(slice(b[0] - lo, b[1] - lo)
+                       for b, lo in zip(box, los))
+        block[target] = np.asarray(s.data)
+    if all(lo == 0 and hi == dim
+           for lo, hi, dim in zip(los, his, shape)):
+        return block, None   # this host sees the whole array
+    return block, tuple((int(lo), int(hi)) for lo, hi in zip(los, his))
+
+
+def _flat_add(flat: dict, key: str, leaf, multiproc: bool) -> None:
+    """Record one leaf under ``key``, with ``range.``/``gshape.``
+    sidecars when only this host's block is stored.  bfloat16 leaves
+    are stored as uint16 bit views with an ``optdtype.``-style sidecar
+    (npz cannot round-trip ml_dtypes) — the loader views them back."""
+    import jax
+
+    gshape = tuple(getattr(leaf, "shape", np.shape(leaf)))
+    if multiproc and isinstance(leaf, jax.Array) \
+            and not leaf.is_fully_addressable:
+        block, box = _host_block(leaf)
+    else:
+        block, box = np.asarray(leaf), None
+    if block.dtype.name == "bfloat16":
+        flat[key] = block.view(np.uint16)
+        flat[f"leafdtype.{key}"] = np.asarray("bfloat16")
+    else:
+        flat[key] = block
+    if box is not None:
+        flat[f"range.{key}"] = np.asarray(box, np.int64)
+        flat[f"gshape.{key}"] = np.asarray(gshape, np.int64)
+
+
+def _encode_payload(flat: dict) -> bytes:
+    """npz bytes + integrity footer: serialized in memory so the footer
+    hashes exactly the bytes that land on disk."""
     import io
 
     buf = io.BytesIO()
@@ -140,18 +234,204 @@ def save_step(checkpoint_dir: str, step: str, params: dict,
     payload = buf.getvalue()
     footer = (_FOOTER_MAGIC + struct.pack("<Q", len(payload))
               + hashlib.sha256(payload).digest())
+    return payload + footer
+
+
+def save_step(checkpoint_dir: str, step: str, params: dict,
+              losses: np.ndarray, extra: Optional[dict] = None,
+              opt_state=None, num_iters: Optional[int] = None,
+              converged: bool = True, nan_abort: bool = False,
+              mesh=None, coordinate: bool = True) -> str:
+    """Persist one step's state; sharding- and topology-aware.
+
+    Single-process: one atomic ``pert_<step>.npz`` exactly as before
+    (rotate-previous retention, integrity footer), now carrying the
+    topology stamp.  Multi-process: every host writes ITS cells-rows
+    (gathered from addressable shards — the global tensor is never
+    materialised anywhere) to a per-host shard file, then a barrier,
+    then process 0 atomically commits the generation pointer
+    (``pert_<step>.commit.json``) — the **two-phase commit**.  A
+    preemption anywhere in the window leaves the previous COMPLETE
+    generation visible: shard files without a commit pointing at them
+    do not exist as far as ``load_step`` is concerned, so ``--resume
+    auto`` can never see a mixed-step or partially-written checkpoint.
+
+    ``params``/``opt_state``/``extra`` leaves may be numpy, host
+    jax.Arrays, or multi-host global jax.Arrays (see
+    :func:`host_view`); ``mesh`` (optional) enriches the topology
+    stamp with the mesh axes the leaves were placed on.
+
+    ``coordinate=False`` (the EMERGENCY path — a dying process saving
+    on the way out of an escaping exception) writes only phase 1 of a
+    multi-process save: this host's shard file, no barrier, no commit.
+    A process that is going away cannot ask its peers to rendezvous —
+    they may be mid-chunk, or already dead — so the generation stays
+    uncommitted and invisible; resume falls back to the last COMMITTED
+    generation, which is precisely the two-phase visibility contract.
+    Single-process saves ignore the flag (one atomic file either way).
+    """
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    from scdna_replication_tools_tpu.parallel.distributed import (
+        process_rank_and_count,
+    )
+
+    kproc, nproc = process_rank_and_count()
+    multiproc = nproc > 1
+
+    flat: dict = {}
+    for k, v in params.items():
+        _flat_add(flat, f"param.{k}", v, multiproc)
+    flat["losses"] = np.asarray(losses)
+    flat["meta.format_version"] = np.asarray(CHECKPOINT_FORMAT_VERSION)
+    flat["meta.num_iters"] = np.asarray(
+        num_iters if num_iters is not None else len(losses))
+    flat["meta.converged"] = np.asarray(bool(converged))
+    flat["meta.nan_abort"] = np.asarray(bool(nan_abort))
+    flat["meta.topology"] = np.asarray(json.dumps(topology_stamp(mesh)))
+    if opt_state is not None:
+        # flatten generically; the reader rebuilds the treedef from a
+        # fresh optax init over the restored params (same structure).
+        # Dtype-aware (optimizer_state_dtype='bfloat16'): npz cannot
+        # round-trip ml_dtypes.bfloat16, so bfloat16 leaves are stored
+        # as uint16 BIT VIEWS with a per-leaf sidecar the loader uses
+        # to view them back — bit-exact both ways (_flat_add).  The
+        # summary ``meta.opt_moment_dtype`` is what the runner's
+        # resume gate compares against the configured dtype.
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(opt_state)
+        moment_dtype = "float32"
+        for i, leaf in enumerate(leaves):
+            _flat_add(flat, f"opt.{i}", leaf, multiproc)
+            if f"leafdtype.opt.{i}" in flat:
+                moment_dtype = "bfloat16"
+        flat["meta.opt_moment_dtype"] = np.asarray(moment_dtype)
+    for k, v in (extra or {}).items():
+        _flat_add(flat, f"extra.{k}", v, multiproc)
+
+    if multiproc:
+        return _save_step_multiprocess(checkpoint_dir, step, flat,
+                                       nproc, kproc, mesh,
+                                       coordinate=coordinate)
+
+    # single-process: atomic commit with retention — rotate the
+    # previous good file aside BEFORE replacing it, so a corrupt new
+    # file (partial write + crash, or the injected corruption fault)
+    # always leaves a fallback
+    path = _step_path(checkpoint_dir, step)
+    blob = _encode_payload(flat)
     if os.path.exists(path):
         try:
             os.replace(path, _prev_path(path))
         except OSError as exc:
             logger.warning("checkpoint retention: could not rotate %s "
                            "(%s)", path, exc)
-    atomic_write_bytes(path, payload + footer)
+    atomic_write_bytes(path, blob)
+    # a fresh single-file save supersedes any sharded generation this
+    # step accumulated under a previous (multi-host) topology: retire
+    # the commit POINTER atomically (the shard files become invisible
+    # with it; kept on disk as forensics until the next save's
+    # retention pass)
+    commit = _commit_path(checkpoint_dir, step)
+    if os.path.exists(commit):
+        try:
+            os.replace(commit, commit + ".superseded")
+        except OSError as exc:
+            logger.warning("could not retire superseded sharded "
+                           "checkpoint commit %s (%s)", commit, exc)
 
     from scdna_replication_tools_tpu.utils import faults as _faults
 
     if _faults.point(f"{step}/save") == "corrupt":
         _faults.corrupt_file(path)
+    return path
+
+
+def _read_commit(checkpoint_dir: str, step: str) -> Optional[dict]:
+    """Parse the step's sharded-generation commit pointer, or None."""
+    path = _commit_path(checkpoint_dir, step)
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+        if not isinstance(doc, dict) or "files" not in doc:
+            raise ValueError("not a checkpoint commit document")
+        return doc
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError) as exc:
+        logger.warning("checkpoint commit %s is unreadable (%s) — the "
+                       "sharded generation it pointed at is not "
+                       "loadable", path, exc)
+        return None
+
+
+def _save_step_multiprocess(checkpoint_dir: str, step: str, flat: dict,
+                            nproc: int, kproc: int, mesh,
+                            coordinate: bool = True) -> str:
+    """Phase 1: every host atomically writes + fsyncs its shard file.
+    Barrier.  Phase 2: process 0 atomically commits the generation
+    pointer.  See :func:`save_step` for the visibility contract (and
+    for ``coordinate=False`` — phase 1 only, no rendezvous)."""
+    from scdna_replication_tools_tpu.parallel.distributed import barrier
+    from scdna_replication_tools_tpu.utils import faults as _faults
+
+    prev_doc = _read_commit(checkpoint_dir, step)
+    seq = int(prev_doc["seq"]) + 1 if prev_doc else 1
+    path = _shard_path(checkpoint_dir, step, seq, kproc, nproc)
+    atomic_write_bytes(path, _encode_payload(flat))
+    if _faults.point(f"{step}/save") == "corrupt":
+        _faults.corrupt_file(path)
+    if not coordinate:
+        logger.warning(
+            "emergency (uncoordinated) checkpoint save for %s: wrote "
+            "this host's shard %s but did NOT commit — the generation "
+            "stays invisible; resume uses the last committed one",
+            step, os.path.basename(path))
+        return path
+    barrier(f"pert-ckpt/{step}/s{seq}/written")
+    if kproc == 0:
+        doc = {
+            "format": 1,
+            "seq": seq,
+            "process_count": nproc,
+            "files": [os.path.basename(
+                _shard_path(checkpoint_dir, step, seq, j, nproc))
+                for j in range(nproc)],
+            "topology": topology_stamp(mesh),
+        }
+        if prev_doc:
+            doc["prev"] = {"seq": int(prev_doc["seq"]),
+                           "files": list(prev_doc["files"])}
+        atomic_write_bytes(_commit_path(checkpoint_dir, step),
+                           json.dumps(doc, indent=1).encode())
+        # mirror of the single-file save's commit-pointer retirement:
+        # a stale pert_<step>.npz from a previous (single-process)
+        # attempt must not out-mtime-tiebreak the generation just
+        # committed
+        stale_single = _step_path(checkpoint_dir, step)
+        if os.path.exists(stale_single):
+            try:
+                os.replace(stale_single, stale_single + ".superseded")
+            except OSError as exc:
+                logger.warning("could not retire superseded single-"
+                               "file checkpoint %s (%s)", stale_single,
+                               exc)
+        # bounded retention: generations older than `prev` are dead
+        keep = {seq} | ({int(prev_doc["seq"])} if prev_doc else set())
+        import glob as _glob
+        import re as _re
+
+        for old in _glob.glob(os.path.join(
+                checkpoint_dir, f"pert_{step}.s*.p*of*.npz")):
+            m = _re.search(r"\.s(\d+)\.p\d+of\d+\.npz$", old)
+            if m and int(m.group(1)) not in keep:
+                try:
+                    os.unlink(old)
+                except OSError:
+                    pass
+    # every host waits for the commit before returning: a caller that
+    # immediately saves again must see THIS generation's seq
+    barrier(f"pert-ckpt/{step}/s{seq}/committed")
     return path
 
 
@@ -168,7 +448,13 @@ def quarantine_stale(checkpoint_dir: str) -> int:
     try:
         import glob
 
-        for path in glob.glob(os.path.join(checkpoint_dir, "pert_*.npz")):
+        # shard files (pert_<step>.sN.pKofM.npz) match the same glob;
+        # the commit pointers must be retired WITH them or a later
+        # multi-host run would chase dangling generation references
+        stale = glob.glob(os.path.join(checkpoint_dir, "pert_*.npz")) \
+            + glob.glob(os.path.join(checkpoint_dir,
+                                     "pert_*.commit.json"))
+        for path in stale:
             try:
                 os.replace(path, path + ".stale")
                 moved += 1
@@ -220,18 +506,122 @@ def _verify_and_read(path: str):
             path, f"unparseable npz ({type(exc).__name__}: {exc})")
 
 
+def _npz_dict(data) -> dict:
+    """Materialise a verified npz archive into a plain dict."""
+    return {k: data[k] for k in data.files}
+
+
+def _merge_generation(flats: list) -> dict:
+    """Reassemble one flat checkpoint mapping from per-host shard files.
+
+    Leaves without a ``range.`` sidecar are host-identical (replicated
+    or whole-array) — the first file's copy wins.  Sliced leaves are
+    placed back into a zero-initialised global array at their recorded
+    boxes; the per-host tiling is contiguous and even (HostShard), so
+    the boxes exactly tile the global extent.
+    """
+    merged: dict = {}
+    keys = list(dict.fromkeys(k for flat in flats for k in flat))
+    for key in keys:
+        if key.startswith("range.") or key.startswith("gshape."):
+            continue
+        range_key = f"range.{key}"
+        if not any(range_key in flat for flat in flats):
+            for flat in flats:
+                if key in flat:
+                    merged[key] = flat[key]
+                    break
+            continue
+        out = None
+        for flat in flats:
+            if key not in flat:
+                continue
+            block = flat[key]
+            if range_key not in flat:
+                # a host that saw the whole array (e.g. after a shrink
+                # to fewer hosts than the commit's writer set expected)
+                out = np.array(block)
+                break
+            box = np.asarray(flat[range_key])
+            if out is None:
+                gshape = tuple(int(v) for v in flat[f"gshape.{key}"])
+                out = np.zeros(gshape, block.dtype)
+            out[tuple(slice(int(lo), int(hi)) for lo, hi in box)] = block
+        merged[key] = out
+    return merged
+
+
+def _load_sharded(checkpoint_dir: str, step: str, doc: dict):
+    """Load + merge one committed sharded generation, falling back to
+    the retained previous generation when the committed one fails
+    verification (the multi-file analog of the ``.prev`` fallback)."""
+
+    def read_gen(files):
+        flats = []
+        for name in files:
+            path = os.path.join(checkpoint_dir, name)
+            flats.append(_npz_dict(_verify_and_read(path)))
+        return flats
+
+    try:
+        flats = read_gen(doc["files"])
+    except CheckpointCorrupt as exc:
+        prev = doc.get("prev")
+        if not prev:
+            raise
+        logger.warning("%s — falling back to the retained previous "
+                       "sharded generation (seq %s)", exc,
+                       prev.get("seq"))
+        try:
+            flats = read_gen(prev["files"])
+        except CheckpointCorrupt:
+            raise exc from None   # report the NEWEST generation
+    return _unpack(f"{checkpoint_dir}/pert_{step}.commit.json",
+                   _merge_generation(flats))
+
+
 def load_step(checkpoint_dir: str, step: str):
     """Returns (params, losses, extra), or None if no checkpoint exists.
 
-    ``extra`` carries the ``meta.*`` record, any ``opt.N`` optimiser
+    ``extra`` carries the ``meta.*`` record (including the parsed
+    ``meta.topology`` stamp for v4+ files), any ``opt.N`` optimiser
     leaves (rebuild the pytree with :func:`restore_opt_state`) and any
     ``ctrl.*``/``best.*`` controller resume state.  A corrupt newest
     file falls back to the retained ``.prev`` checkpoint (with a
     warning); when no fallback survives verification either, raises
     :class:`CheckpointCorrupt` for the NEWEST file — the caller decides
     whether a fresh refit is acceptable.
+
+    Topology-portable: a step saved as a multi-host sharded generation
+    (commit pointer + per-host shard files) is reassembled into full
+    global arrays regardless of the CURRENT topology — the caller
+    re-places them onto whatever mesh it runs (resharding resume).
+    When both a sharded generation and a single file exist (a resumed
+    run changed process count mid-history), the newer artifact wins.
     """
     path = _step_path(checkpoint_dir, step)
+    doc = _read_commit(checkpoint_dir, step)
+    if doc is not None:
+        if os.path.exists(path):
+            # both formats present: the newest save wins — each save
+            # path retires the OTHER format's artifact after
+            # committing its own, so coexistence is a crash window
+            # between commit and retirement.  On an mtime TIE (coarse
+            # filesystems) the single file wins: the only same-second
+            # window is the single-process save's (npz written, crash
+            # before the commit pointer retired — the npz is the newer
+            # progress); a fresh sharded generation's stale-npz window
+            # closes against an npz from a PREVIOUS attempt, minutes
+            # older.
+            try:
+                commit_mtime = os.path.getmtime(
+                    _commit_path(checkpoint_dir, step))
+                if os.path.getmtime(path) >= commit_mtime:
+                    doc = None
+            except OSError:
+                doc = None
+        if doc is not None:
+            return _load_sharded(checkpoint_dir, step, doc)
     if not os.path.exists(path):
         prev = _prev_path(path)
         if os.path.exists(prev):
@@ -243,7 +633,7 @@ def load_step(checkpoint_dir: str, step: str):
                 "exists (crash between rotation and commit?) — "
                 "restoring %s", path, prev)
             data = _verify_and_read(prev)
-            return _unpack(prev, data)
+            return _unpack(prev, _npz_dict(data))
         return None
     try:
         data = _verify_and_read(path)
@@ -259,27 +649,46 @@ def load_step(checkpoint_dir: str, step: str):
                 raise exc from None   # report the NEWEST file
         else:
             raise
-    return _unpack(path, data)
+    return _unpack(path, _npz_dict(data))
 
 
-def _unpack(path: str, data):
-    """(params, losses, extra) from a verified npz archive."""
-    params = {k[len("param."):]: data[k] for k in data.files
+def _unpack(path: str, data: dict):
+    """(params, losses, extra) from a verified flat mapping."""
+    params = {k[len("param."):]: data[k] for k in data
               if k.startswith("param.")}
-    extra = {k[len("extra."):]: data[k] for k in data.files
+    extra = {k[len("extra."):]: data[k] for k in data
              if k.startswith("extra.")}
-    for k in data.files:
+    for k in data:
         if k.startswith("meta.") or k.startswith("opt."):
             extra[k] = data[k]
-    # bfloat16 moments round-trip: uint16 bit views back to bfloat16
-    # (see save_step) — readers downstream never see the storage trick
-    for k in data.files:
-        if k.startswith("optdtype."):
-            leaf_key = "opt." + k[len("optdtype."):]
-            if str(data[k]) == "bfloat16" and leaf_key in extra:
-                import ml_dtypes
+    # bfloat16 leaves round-trip: uint16 bit views back to bfloat16
+    # (see _flat_add; `optdtype.` is the pre-v4 spelling of the same
+    # sidecar) — readers downstream never see the storage trick
+    for k in data:
+        if k.startswith("optdtype.") or k.startswith("leafdtype."):
+            if k.startswith("optdtype."):
+                target = "opt." + k[len("optdtype."):]
+            else:
+                target = k[len("leafdtype."):]
+            if str(data[k]) != "bfloat16":
+                continue
+            import ml_dtypes
 
-                extra[leaf_key] = extra[leaf_key].view(ml_dtypes.bfloat16)
+            if target.startswith("param."):
+                name = target[len("param."):]
+                if name in params:
+                    params[name] = params[name].view(ml_dtypes.bfloat16)
+            elif target.startswith("extra."):
+                name = target[len("extra."):]
+                if name in extra:
+                    extra[name] = extra[name].view(ml_dtypes.bfloat16)
+            elif target in extra:
+                extra[target] = extra[target].view(ml_dtypes.bfloat16)
+    if "meta.topology" in extra:
+        try:
+            extra["meta.topology"] = json.loads(str(extra["meta.topology"]))
+        except (TypeError, ValueError):
+            extra["meta.topology"] = None
     version = int(extra.get("meta.format_version", 1))
     if version < 2 and "pi_logits" in params and params["pi_logits"].ndim == 3:
         raise ValueError(
@@ -345,7 +754,11 @@ def restore_controller_state(extra: dict) -> Optional[dict]:
 
 def pack_controller_state(state: dict) -> dict:
     """Flatten an ``infer/svi.py`` controller state dict into the
-    ``extra`` keys :func:`restore_controller_state` reads back."""
+    ``extra`` keys :func:`restore_controller_state` reads back.
+
+    Leaves go through :func:`host_view`: multi-host global arrays
+    (the best-loss params of a sharded fit, the replicated diag ring)
+    pass through for :func:`save_step` to gather per host."""
     out = {
         "ctrl.format": 1,
         "ctrl.reseeds": int(state["reseeds"]),
@@ -357,9 +770,9 @@ def pack_controller_state(state: dict) -> dict:
         "ctrl.prev_verdict": state.get("prev_verdict") or "",
         "ctrl.best_loss": float(state["best_loss"]),
         "ctrl.best_it": int(state["best_it"]),
-        "ctrl.diag": np.asarray(state["diag"]),
+        "ctrl.diag": host_view(state["diag"]),
         "ctrl.diag_i0": int(state["diag_i0"]),
     }
     for k, v in (state.get("best_params") or {}).items():
-        out[f"best.{k}"] = np.asarray(v)
+        out[f"best.{k}"] = host_view(v)
     return out

@@ -25,6 +25,22 @@ e.g. a grown iteration budget — is legitimate and only noted);
 ``force`` restores regardless; ``off`` ignores existing state.  A
 fingerprint mismatch under ``auto`` resets the step ledger: checkpoints
 fitted to other data must not be offered for resume again.
+
+Multi-host contract: the manifest FILE is committed by process 0 only
+(:meth:`RunManifest.save` is a no-op elsewhere — peers keep their
+in-memory copy; every process racing ``os.replace`` on one shared
+``manifest.json`` was the latent single-process assumption), and the
+recorded identity is host-count-portable: each host fingerprints the
+data IT loaded, the per-host digests are all-gathered
+(:func:`all_host_fingerprints`), and the canonical
+``data_fingerprint`` is their **deduplicated fingerprint-of-
+fingerprints** (:func:`combined_fingerprint`) — when every host loaded
+the same full batch (the current loader bridge) the combined digest
+equals the local one, so a checkpoint written by a 4-host run verifies
+on 1 host and vice versa; only genuinely different data refuses.  The
+per-host map is recorded alongside so a same-shape resume can also
+verify each rank's local shard individually (``match``'s per-host
+fallback).
 """
 
 from __future__ import annotations
@@ -87,6 +103,72 @@ def data_fingerprint(*arrays, samples: int = _FP_SAMPLES) -> str:
     return digest.hexdigest()[:16]
 
 
+def all_host_fingerprints(local_fp: str) -> dict:
+    """``{process_index: fingerprint}`` across every host.
+
+    Single-process (or no jax runtime): ``{0: local_fp}``.  Multi-
+    process: an all-gather of each rank's digest — every host returns
+    the SAME map, so the combined identity below is computed
+    identically everywhere without trusting any one host's view of the
+    data.
+    """
+    from scdna_replication_tools_tpu.parallel.distributed import (
+        process_rank_and_count,
+    )
+
+    _, nproc = process_rank_and_count()
+    if nproc <= 1:
+        return {0: str(local_fp)}
+    from jax.experimental import multihost_utils
+
+    buf = np.frombuffer(str(local_fp).encode("ascii"), np.uint8)
+    gathered = np.asarray(multihost_utils.process_allgather(buf))
+    return {k: bytes(gathered[k]).decode("ascii") for k in range(nproc)}
+
+
+def combined_fingerprint(host_fps: dict) -> str:
+    """The canonical multi-host data fingerprint: the per-host digests
+    deduplicated, then (only when they genuinely differ) hashed in rank
+    order.
+
+    Dedup first is what keeps the identity HOST-COUNT-portable for the
+    current loader bridge: every host materialises the same full batch,
+    so all ranks digest identically and the combined fingerprint IS the
+    single-host fingerprint — a 4-host checkpoint verifies on 1 host.
+    When a future per-shard loader gives each host different bytes, the
+    ordered fingerprint-of-fingerprints takes over (and a resume on a
+    different host count then legitimately refuses: nobody has hashed
+    the data THIS topology would load)."""
+    vals = [str(host_fps[k]) for k in sorted(host_fps)]
+    if len(set(vals)) == 1:
+        return vals[0]
+    return hashlib.sha256("|".join(vals).encode()).hexdigest()[:16]
+
+
+def consensus_ok(local_ok: bool) -> bool:
+    """AND of a per-rank boolean across every host (identity when
+    single-process).
+
+    The resume verdict must be SPMD-consistent: ``match``'s per-host
+    fallback judges purely local data, and a split verdict (rank 0
+    restores mid-budget while rank 1 starts fresh) would desynchronize
+    the lockstep fit at the first collective.  Any rank's refusal
+    therefore refuses everywhere — the conservative direction (a
+    spurious full refit, never a wrong restore)."""
+    from scdna_replication_tools_tpu.parallel.distributed import (
+        process_rank_and_count,
+    )
+
+    _, nproc = process_rank_and_count()
+    if nproc <= 1:
+        return bool(local_ok)
+    from jax.experimental import multihost_utils
+
+    flags = np.asarray(multihost_utils.process_allgather(
+        np.asarray([1 if local_ok else 0], np.uint8)))
+    return bool(flags.min() == 1)
+
+
 class RunManifest:
     """The per-checkpoint-directory resume ledger (see module docstring).
 
@@ -125,13 +207,23 @@ class RunManifest:
     # -- identity ---------------------------------------------------------
 
     def match(self, config_hash: Optional[str],
-              fingerprint: Optional[str]) -> Tuple[bool, str]:
+              fingerprint: Optional[str],
+              host_fingerprint: Optional[str] = None,
+              process_index: Optional[int] = None) -> Tuple[bool, str]:
         """(data_ok, reason) against the manifest's recorded identity.
 
         ``data_ok`` is the resume gate: True only when the recorded data
         fingerprint exists and matches.  The reason string also reports
         a config-hash drift (informational — budgets legitimately grow
         between a partial run and its resume).
+
+        ``host_fingerprint``/``process_index`` arm the multi-host
+        fallback: when the combined digest drifted (e.g. the writer set
+        recorded a genuine fingerprint-of-fingerprints and this resume
+        runs a different host count) but THIS rank's local shard still
+        digests exactly what the same rank recorded, the data under
+        this host is verified — a same-topology resume must not refuse
+        because a peer's shard moved the combined hash.
         """
         recorded_fp = self.doc.get("data_fingerprint")
         recorded_cfg = self.doc.get("config_hash")
@@ -139,6 +231,29 @@ class RunManifest:
             return False, "no recorded data fingerprint (legacy or " \
                           "fresh checkpoint directory)"
         if fingerprint != recorded_fp:
+            hosts = self.doc.get("host_fingerprints") or {}
+            # the fallback is a SAME-SHAPE instrument: every recorded
+            # rank must be alive to re-verify its own shard, so the
+            # current host count must equal the recorded one
+            # (fingerprint_process_count) — a smaller resume passing on
+            # the surviving ranks alone would leave the missing hosts'
+            # recorded data unverified, exactly the case the module
+            # docstring promises refuses
+            from scdna_replication_tools_tpu.parallel.distributed import (
+                process_rank_and_count,
+            )
+
+            recorded_n = int(self.doc.get("fingerprint_process_count",
+                                          len(hosts)) or len(hosts))
+            same_shape = process_rank_and_count()[1] == recorded_n
+            if same_shape and host_fingerprint is not None \
+                    and process_index is not None \
+                    and hosts.get(str(int(process_index))) \
+                    == str(host_fingerprint):
+                return True, (f"per-host data fingerprint verified for "
+                              f"process {int(process_index)} (combined "
+                              f"digest drifted: manifest {recorded_fp}, "
+                              f"current {fingerprint})")
             return False, (f"data fingerprint mismatch (manifest "
                            f"{recorded_fp}, current {fingerprint}) — "
                            f"checkpoints belong to different input data")
@@ -152,15 +267,27 @@ class RunManifest:
     def begin_run(self, config_hash: Optional[str],
                   fingerprint: Optional[str],
                   run_log_path: Optional[str] = None,
-                  reset_steps: bool = False) -> None:
+                  reset_steps: bool = False,
+                  host_fingerprints: Optional[dict] = None) -> None:
         """Record this attempt's identity (and its RunLog path) in the
         ledger; ``reset_steps`` drops the step statuses (the fingerprint
-        changed — the old checkpoints are not resumable state)."""
+        changed — the old checkpoints are not resumable state).
+        ``host_fingerprints`` (multi-host runs) records the per-rank
+        map behind the combined digest for ``match``'s per-host
+        fallback."""
         if reset_steps:
             self.doc["steps"] = {}
         self.doc["manifest_version"] = MANIFEST_VERSION
         self.doc["config_hash"] = config_hash
         self.doc["data_fingerprint"] = fingerprint
+        if host_fingerprints is not None and len(host_fingerprints) > 1:
+            self.doc["host_fingerprints"] = {
+                str(int(k)): str(v)
+                for k, v in sorted(host_fingerprints.items())}
+            self.doc["fingerprint_process_count"] = len(host_fingerprints)
+        else:
+            self.doc.pop("host_fingerprints", None)
+            self.doc.pop("fingerprint_process_count", None)
         runs = self.doc.setdefault("runs", [])
         runs.append({"started_unix": round(time.time(), 3),
                      "pid": os.getpid(),
@@ -193,7 +320,21 @@ class RunManifest:
     def save(self) -> None:
         """Atomic commit; never raises (a read-only checkpoint mount
         degrades to an unverifiable-but-working run, mirroring the
-        RunLog's never-abort discipline)."""
+        RunLog's never-abort discipline).
+
+        Process-0-only in multi-host runs: every rank keeps its
+        in-memory ledger current (``step()`` reads work everywhere),
+        but only the coordinator commits the shared file — N ranks
+        racing ``os.replace`` on one ``manifest.json`` would interleave
+        generations nondeterministically, and the two-phase checkpoint
+        commit already nominates process 0 as the single committer."""
+        from scdna_replication_tools_tpu.parallel.distributed import (
+            process_rank_and_count,
+        )
+
+        rank, nproc = process_rank_and_count()
+        if nproc > 1 and rank != 0:
+            return
         try:
             blob = json.dumps(self.doc, indent=1, sort_keys=True)
             atomic_write_bytes(self.path, blob.encode())

@@ -68,6 +68,7 @@ from scdna_replication_tools_tpu.parallel.mesh import (
     CELLS_AXIS,
     LOCI_AXIS,
     make_mesh,
+    replicate_fixed,
     shard_batch,
     shard_params,
 )
@@ -236,27 +237,60 @@ class PertInference:
             # everything the fit consumes, not just reads: changed CN
             # states, clone assignments or the RT prior also invalidate
             # old checkpoints (the priors/conditioning they shaped)
-            fingerprint = manifest_mod.data_fingerprint(
+            local_fp = manifest_mod.data_fingerprint(
                 s_data.reads, g1_data.reads, s_data.states,
                 g1_data.states, clone_idx_s, clone_idx_g1,
                 s_data.rt_prior)
+            # multi-host identity: each rank digests what IT loaded,
+            # the combined fingerprint is the deduped fingerprint-of-
+            # fingerprints (host-count-portable while every host loads
+            # the full batch — see infer/manifest.py)
+            host_fps = manifest_mod.all_host_fingerprints(local_fp)
+            fingerprint = manifest_mod.combined_fingerprint(host_fps)
+            from scdna_replication_tools_tpu.parallel.distributed import (
+                process_rank_and_count,
+            )
+
+            proc_index, _ = process_rank_and_count()
             cfg_hash = _config_digest(config)
             m = manifest_mod.RunManifest.load(config.checkpoint_dir)
-            self._resume_ok, self._resume_reason = m.match(cfg_hash,
-                                                           fingerprint)
+            self._resume_ok, self._resume_reason = m.match(
+                cfg_hash, fingerprint, host_fingerprint=local_fp,
+                process_index=proc_index)
+            # the per-host fallback judges LOCAL data: make the verdict
+            # SPMD-consistent (any rank's refusal refuses everywhere)
+            # or a split verdict would desynchronize the lockstep fit.
+            # Every rank enters the allgather — a verdict-gated call
+            # would itself deadlock on the exact split it guards against
+            agreed = manifest_mod.consensus_ok(self._resume_ok)
+            if self._resume_ok and not agreed:
+                self._resume_ok = False
+                self._resume_reason = (
+                    "a peer process refused the data fingerprint "
+                    "(split per-host verdict — resuming on partial "
+                    "agreement would desynchronize the ranks)")
             had_identity = m.doc.get("data_fingerprint") is not None
             reset = (config.resume == "off"
                      or (had_identity and not self._resume_ok
                          and config.resume != "force"))
-            if reset:
+            if reset and proc_index == 0:
                 # voiding the ledger must also retire the FILES: once
                 # this run's identity lands in the manifest, surviving
                 # stale checkpoints would fingerprint-verify for the
-                # next run and restore params fitted to other data
+                # next run and restore params fitted to other data.
+                # Process 0 only — N ranks racing the renames on one
+                # shared directory would half-quarantine generations.
                 ckpt.quarantine_stale(config.checkpoint_dir)
             m.begin_run(cfg_hash, fingerprint,
                         run_log_path=self.run_log.path,
-                        reset_steps=reset)
+                        reset_steps=reset, host_fingerprints=host_fps)
+            from scdna_replication_tools_tpu.parallel.distributed import (
+                barrier,
+            )
+
+            # peers must not race ahead and load a checkpoint process 0
+            # is mid-quarantine / mid-commit on
+            barrier("pert-manifest/begin_run")
             self._manifest = m
             if had_identity and not self._resume_ok \
                     and config.resume == "auto":
@@ -310,9 +344,103 @@ class PertInference:
             etas_padded, allow_sparse=self.config.sparse_etas)
 
     def _maybe_shard(self, batch: PertBatch, params: dict):
+        """Place batch + params on the current mesh (single- or
+        multi-host).
+
+        Multi-process bridge: the loader still materialises the full
+        batch in every process, so each host slices the cells-rows its
+        ``HostShard`` assigns before ``shard_*_multihost`` assembles
+        the global jax.Arrays — the placement-level contract is the
+        production one even while the loader catches up (ROADMAP 1).
+        This is also the RESHARDING seam: checkpointed state loads as
+        full host arrays and lands here to be re-placed on whatever
+        mesh this run built, whatever mesh wrote it."""
         if self._mesh is None:
             return batch, params
+        import jax
+
+        if jax.process_count() > 1:
+            from scdna_replication_tools_tpu.parallel import (
+                distributed as dist,
+            )
+
+            shard = dist.HostShard.for_this_process(
+                int(np.asarray(batch.reads).shape[0]))
+            local_batch = dist.slice_local_batch(batch, shard)
+            local_params = dist.slice_local_params(params, shard)
+            return (dist.shard_batch_multihost(self._mesh, local_batch,
+                                               shard),
+                    dist.shard_params_multihost(self._mesh, local_params,
+                                                shard))
         return shard_batch(self._mesh, batch), shard_params(self._mesh, params)
+
+    def _place_params(self, params: dict) -> dict:
+        """Place a host-materialised parameter pytree on the current
+        mesh (identity placement to the default device when no mesh).
+
+        The mirror rescue's splice (and any other host-side param
+        surgery) must route through this: rebuilding leaves with bare
+        ``jnp.asarray`` silently DE-SHARDS the model state, forcing
+        every downstream decode/QC pass onto one device — the exact
+        failure ``test_sharded_partial_fit_resume_is_exact`` pins."""
+        if self._mesh is None:
+            return {k: jnp.asarray(v) for k, v in params.items()}
+        import jax
+
+        if jax.process_count() > 1:
+            from scdna_replication_tools_tpu.parallel import (
+                distributed as dist,
+            )
+
+            ncells = int(np.asarray(params["tau_raw"]).shape[0])
+            shard = dist.HostShard.for_this_process(ncells)
+            return dist.shard_params_multihost(
+                self._mesh, dist.slice_local_params(params, shard), shard)
+        return shard_params(self._mesh,
+                            {k: jnp.asarray(v) for k, v in params.items()})
+
+    def _place_opt_state(self, opt_state, num_cells: int):
+        """Re-place a checkpoint-restored optimizer state onto the
+        current mesh: mu/nu leaves inherit their parameter's
+        PartitionSpec (the dict key IS the parameter name), everything
+        else (the step count) replicates.  Host arrays from ANY saved
+        topology come out as arrays of THIS one — the optimizer-state
+        half of a resharding resume."""
+        if opt_state is None or self._mesh is None:
+            return opt_state
+        import jax
+        from jax.sharding import NamedSharding
+
+        from scdna_replication_tools_tpu import layout
+        from scdna_replication_tools_tpu.parallel import distributed as dist
+        from scdna_replication_tools_tpu.parallel.mesh import loci_axis
+
+        specs = layout.param_specs(loci_axis(self._mesh))
+        multiproc = jax.process_count() > 1
+        shard = dist.HostShard.for_this_process(num_cells) if multiproc \
+            else None
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(opt_state)
+        placed = []
+        for path, leaf in leaves:
+            name = None
+            for key in reversed(path):
+                if isinstance(key, jax.tree_util.DictKey):
+                    name = key.key
+                    break
+            spec = specs.get(name, layout.replicated_spec())
+            if multiproc:
+                local = leaf
+                axis = layout.param_cells_axis(name) if name else None
+                if axis is not None:
+                    local = dist.slice_cells_axis(leaf, axis, shard)
+                placed.append(dist._place(self._mesh, local, spec,
+                                          shard.num_global_cells))
+            else:
+                placed.append(jax.device_put(
+                    leaf, NamedSharding(self._mesh, spec)))
+        return jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(opt_state),
+            placed)
 
     def _warn_if_enum_tensor_huge(self, spec: PertModelSpec,
                                   batch: PertBatch) -> None:
@@ -463,6 +591,15 @@ class PertInference:
         * **oom** / **hang** abort with the resumable artifact that
           same save left behind (plus a ``degrade`` audit event) — the
           next ``--resume auto`` run continues mid-budget;
+        * **hostloss** (and REPEATED OOM — the first sharded OOM gets
+          one audited same-mesh re-entry, since shrinking raises
+          per-device load) in a SHARDED fit walks the **elastic
+          rung**: rebuild a smaller mesh (halve the cells axis,
+          ultimately one device), re-place the last checkpoint through
+          the normal resume path, and continue — every shrink audited
+          as a ``degrade mesh_shrink`` event with before/after
+          topology; when the ladder is exhausted the fit aborts with
+          the resumable artifact like any other OOM;
         * **preemption** (BaseException) propagates untouched after the
           graceful save: the process is going away;
         * **deterministic** errors propagate immediately — retrying a
@@ -476,7 +613,8 @@ class PertInference:
                                       max_iter, min_iter, step_name)
             except Exception as exc:
                 kind = faults_mod.classify_exception(exc)
-                if kind in ("oom", "hang"):
+                if kind in ("oom", "hang", "hostloss") \
+                        and not self._shrink_eligible(kind):
                     self.run_log.emit(
                         "degrade", step=step_name,
                         action=("watchdog_abort" if kind == "hang"
@@ -493,11 +631,106 @@ class PertInference:
         # transient classification, deterministic backoff and the
         # `retry` audit event all live in ONE place (utils/faults.py);
         # each retry re-enters _fit_once, whose _load_resumable picks
-        # up the in-flight checkpoint — retries RESUME, not restart
-        return faults_mod.retry_call(
-            attempt, label=f"{step_name}/fit",
-            max_attempts=int(cfg.retry_max_attempts),
-            base_delay=float(cfg.retry_backoff_seconds))
+        # up the in-flight checkpoint — retries RESUME, not restart.
+        # The outer loop is the ELASTIC rung: a hostloss/OOM that
+        # escapes the retry ladder shrinks the mesh (bounded — each
+        # pass halves the cells extent) and re-enters, which re-places
+        # the emergency checkpoint on the smaller topology.
+        oom_count = 0
+        while True:
+            try:
+                return faults_mod.retry_call(
+                    attempt, label=f"{step_name}/fit",
+                    max_attempts=int(cfg.retry_max_attempts),
+                    base_delay=float(cfg.retry_backoff_seconds))
+            except Exception as exc:
+                kind = faults_mod.classify_exception(exc)
+                if kind == "oom":
+                    # shrinking the cells axis RAISES per-device load
+                    # (fewer devices carry the same cells), so the rung
+                    # engages only on REPEATED OOM as the recovery
+                    # contract specifies: the first sharded OOM gets
+                    # one audited same-mesh re-entry (resuming the
+                    # in-flight checkpoint — an allocator spike or
+                    # fragmentation clears; a genuine roofline OOM
+                    # recurs immediately and then walks the ladder).
+                    # hostloss shrinks at once: the device is GONE.
+                    oom_count += 1
+                    if oom_count == 1 and self._shrink_eligible(kind):
+                        self.run_log.emit(
+                            "retry", label=f"{step_name}/fit-oom",
+                            attempt=1, max_attempts=2,
+                            delay_seconds=0.0, error_class=kind,
+                            error=(f"{type(exc).__name__}: "
+                                   f"{str(exc)[:300]}"))
+                        profiling.logger.warning(
+                            "sharded fit OOM at %s: one same-mesh "
+                            "re-entry from the last checkpoint before "
+                            "the elastic rung engages", step_name)
+                        continue
+                if not self._try_mesh_shrink(step_name, kind, exc):
+                    raise
+
+    def _shrink_eligible(self, kind: str) -> bool:
+        """Would :meth:`_try_mesh_shrink` accept this failure class?
+
+        Elastic shrink is an IN-PROCESS remedy: it needs a mesh with
+        more than one device left, a single controlling process (a
+        multi-host window change goes through preempt -> resume on the
+        next window's shape instead — the checkpoints are topology-
+        portable precisely so that path works), and a hostloss/OOM
+        class.  ``PertConfig.elastic_mesh`` turns the rung off."""
+        from scdna_replication_tools_tpu.parallel.mesh import shrink_mesh
+
+        if not self.config.elastic_mesh or self._mesh is None:
+            return False
+        if kind not in ("hostloss", "oom"):
+            return False
+        import jax
+
+        if jax.process_count() > 1:
+            return False
+        return shrink_mesh(self._mesh) is not None
+
+    def _try_mesh_shrink(self, step_name: str, kind: str,
+                         exc: BaseException) -> bool:
+        """One rung of the elastic ladder: swap ``self._mesh`` for its
+        halved-cells successor and audit the transition.  Returns False
+        (caller re-raises) when the failure class or topology is not
+        eligible — including ladder exhaustion, which the `attempt`
+        audit already recorded as ``abort_resumable``."""
+        from scdna_replication_tools_tpu.parallel.mesh import (
+            mesh_topology,
+            shrink_mesh,
+        )
+
+        if not self._shrink_eligible(kind):
+            return False
+        new_mesh = shrink_mesh(self._mesh)
+        if new_mesh is None:
+            return False
+        before = mesh_topology(self._mesh)
+        after = mesh_topology(new_mesh)
+        self._mesh = new_mesh
+        self.run_log.add_context(mesh={
+            "axes": after,
+            "num_devices": int(len(new_mesh.devices.flat)),
+        })
+        self.run_log.emit(
+            "degrade", step=step_name, action="mesh_shrink",
+            error_class=kind,
+            error=f"{type(exc).__name__}: {str(exc)[:300]}",
+            from_topology={"mesh_axes": before},
+            to_topology={"mesh_axes": after},
+            detail=(f"elastic rung: {kind} in a sharded fit — mesh "
+                    f"shrunk {before} -> {after}; the fit re-enters "
+                    "through the resume path and re-places the last "
+                    "checkpoint on the smaller topology"))
+        profiling.logger.warning(
+            "elastic mesh shrink (%s at %s): %s -> %s — re-entering the "
+            "fit from the last checkpoint", kind, step_name, before,
+            after)
+        return True
 
     def _load_resumable(self, step_name, max_iter, spec, fixed, batch):
         """Resume-mode + manifest-aware checkpoint restore for one step.
@@ -543,6 +776,45 @@ class PertInference:
         converged = bool(extra.get("meta.converged", True))
         nan_abort = bool(extra.get("meta.nan_abort", False))
         resume_ctrl = ckpt.restore_controller_state(extra)
+        # resharding resume: compare the checkpoint's topology stamp
+        # against THIS run's.  Any mesh restores onto any mesh — the
+        # loaded leaves are full host arrays that _maybe_shard /
+        # _place_opt_state re-place from the layout contract — but the
+        # geometry change is audited: bit-exact continuation holds only
+        # when the reduction geometry is unchanged; a cross-topology
+        # resume is parity-gated by the chaos matrix instead (see
+        # tests/test_topology_resume.py), and only a DATA mismatch
+        # refuses (the manifest gate above).  Pre-v4 checkpoints carry
+        # no stamp: geometry unknown, recorded as unstamped.
+        saved_topo = extra.get("meta.topology") \
+            if isinstance(extra.get("meta.topology"), dict) else None
+        from scdna_replication_tools_tpu.parallel.distributed import (
+            process_topology,
+        )
+
+        cur_topo = process_topology(self._mesh)
+        resharded = False
+        if saved_topo is not None:
+            resharded = (
+                saved_topo.get("mesh_axes") != cur_topo["mesh_axes"]
+                or int(saved_topo.get("process_count", 1))
+                != int(cur_topo["process_count"]))
+        reshard_fields = dict(
+            resharded=bool(resharded),
+            from_topology=({"mesh_axes": saved_topo.get("mesh_axes"),
+                            "process_count":
+                                saved_topo.get("process_count")}
+                           if saved_topo is not None else None),
+            to_topology={"mesh_axes": cur_topo["mesh_axes"],
+                         "process_count": cur_topo["process_count"]})
+        if resharded:
+            profiling.logger.warning(
+                "resharding resume for %s: checkpoint topology %s -> "
+                "current %s (bit-exact only when the reduction geometry "
+                "is unchanged; the continued trajectory is parity-"
+                "gated, not identical)", step_name,
+                reshard_fields["from_topology"],
+                reshard_fields["to_topology"])
         # a controller-extended budget survives in the resume state (a
         # fit killed past max_iter but inside its extended budget is
         # still PARTIAL) — but a GROWN config budget wins: resuming
@@ -566,12 +838,19 @@ class PertInference:
             fingerprint_verified=bool(self._resume_ok or own_write),
             reason=("checkpoint written by this run (retry resume)"
                     if own_write and not self._resume_ok
-                    else self._resume_reason))
+                    else self._resume_reason),
+            **reshard_fields)
         if completed:
-            # completed step: restore as-is, no refit.  budget must be
-            # a real integer — the rescue gate's control_decision event
-            # types it as such in the schema, restored fits included
-            fit = FitResult(params=params, losses=losses,
+            # completed step: restore, no refit — but PLACE the
+            # restored host arrays on this run's mesh first: the
+            # decode/QC/conditioning consumers downstream run over
+            # these params, and raw numpy would silently de-shard them
+            # onto one device (the same failure _place_params pins for
+            # the rescue splice).  budget must be a real integer — the
+            # rescue gate's control_decision event types it as such in
+            # the schema, restored fits included
+            fit = FitResult(params=self._place_params(params),
+                            losses=losses,
                             num_iters=num_iters, converged=converged,
                             nan_abort=nan_abort,
                             budget=max(budget, num_iters))
@@ -628,6 +907,15 @@ class PertInference:
                 num_iters=len(losses_prefix)
                 if losses_prefix is not None else 0)
 
+        if self._mesh is not None and jax.process_count() == 1:
+            # the conditioning dict may still be committed to a
+            # PREVIOUS mesh (elastic shrink re-enters the fit inside
+            # this process) — commit it to the current one, replicated;
+            # an unchanged mesh makes this an identity.  Multi-process
+            # fixed leaves are already global arrays of the live mesh
+            # (a multi-host topology change goes through process
+            # restart, which rebuilds them).
+            fixed = replicate_fixed(self._mesh, fixed)
         if params0 is None:
             with self.phases.phase(f"{step_name}/init"):
                 params0 = init_params(spec, batch, fixed, t_init=t_init)
@@ -635,8 +923,15 @@ class PertInference:
         with self.phases.phase(f"{step_name}/h2d"):
             # resharding + an explicit barrier so the async host->device
             # transfers jnp.asarray enqueued are accounted here, not
-            # silently folded into the fit phase
+            # silently folded into the fit phase.  A checkpoint-restored
+            # optimizer state re-places alongside the params — the
+            # restored leaves are full host arrays from WHATEVER
+            # topology wrote them (resharding resume), and the fit
+            # program expects them on this run's mesh.
             batch, params0 = self._maybe_shard(batch, params0)
+            if opt_state0 is not None and self._mesh is not None:
+                opt_state0 = self._place_opt_state(
+                    opt_state0, int(batch.reads.shape[0]))
             batch, params0, fixed = jax.block_until_ready(
                 (batch, params0, fixed))
         from scdna_replication_tools_tpu.ops.enum_kernel import (
@@ -674,13 +969,14 @@ class PertInference:
             # in-fit saves (every checkpoint_every chunks) and the
             # emergency save on an escaping exception both land here
             def checkpoint_cb(*, params, opt_state, losses, num_iters,
-                              state=None, exact=True):
+                              state=None, exact=True, coordinated=True):
                 extra = ckpt.pack_controller_state(state) if state \
                     else None
                 path = ckpt.save_step(
                     cfg.checkpoint_dir, step_name, params, losses,
                     opt_state=opt_state, num_iters=int(num_iters),
-                    converged=False, nan_abort=False, extra=extra)
+                    converged=False, nan_abort=False, extra=extra,
+                    mesh=self._mesh, coordinate=coordinated)
                 self._steps_written.add(step_name)
                 self.run_log.emit(
                     "checkpoint", action="save", step=step_name,
@@ -744,13 +1040,13 @@ class PertInference:
                                                   else max_iter))
             with self.phases.phase(f"{step_name}/checkpoint"):
                 ckpt.save_step(cfg.checkpoint_dir, step_name,
-                               jax.tree_util.tree_map(np.asarray, fit.params),
+                               ckpt.host_view(fit.params),
                                fit.losses,
-                               opt_state=jax.tree_util.tree_map(
-                                   np.asarray, fit.opt_state),
+                               opt_state=ckpt.host_view(fit.opt_state),
                                num_iters=fit.num_iters,
                                converged=fit.converged,
-                               nan_abort=fit.nan_abort)
+                               nan_abort=fit.nan_abort,
+                               mesh=self._mesh)
             self._steps_written.add(step_name)
             self.run_log.emit("checkpoint", action="save", step=step_name,
                               path=str(cfg.checkpoint_dir),
@@ -910,7 +1206,18 @@ class PertInference:
         out = self._fit(spec, batch, fixed, t_init,
                         iters["max_iter"], iters["min_iter"], "step2")
         self._step2_data = s
-        if self.config.mirror_rescue:
+        if jax.process_count() > 1:
+            # the rescue's slice/splice (and the no-rescue hint's tau
+            # read) fetch host copies of the fitted params — a
+            # non-addressable global array cannot be fetched on one
+            # host.  Until the multi-host decode lands (ROADMAP 1),
+            # rescue is a single-controlling-process surface, same gate
+            # as the elastic rung.
+            profiling.logger.info(
+                "step 2: mirror rescue skipped on a %d-process run "
+                "(host-side splice needs addressable params)",
+                jax.process_count())
+        elif self.config.mirror_rescue:
             # controller active: the rescue sub-fit runs only when the
             # QC signals say a candidate is SUSPECT (extreme-boundary
             # tau or high posterior entropy) instead of always-on; the
@@ -1198,7 +1505,10 @@ class PertInference:
         for key in ("tau_raw", "u", "betas"):
             params_np[key][keep] = res_np[key][accept]
         params_np[pi_key][:, keep, :] = res_np[pi_key][:, accept, :]
-        new_params = {k: jnp.asarray(v) for k, v in params_np.items()}
+        # re-place on the production mesh: the splice worked on host
+        # copies, and handing back single-device arrays would de-shard
+        # every downstream decode/QC pass
+        new_params = self._place_params(params_np)
         new_fit = dataclasses.replace(out.fit, params=new_params)
         return dataclasses.replace(out, fit=new_fit)
 

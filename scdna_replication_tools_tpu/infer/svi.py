@@ -792,12 +792,16 @@ def _fit_map_controlled(loss_fn: Callable, params0: dict, loss_args: tuple,
 
 
 def _np_tree_or_none(tree):
-    """Host copy of a pytree, or None when any leaf was donated away
-    (mid-chunk failure: the consumed carries are gone)."""
+    """Host-transferable view of a pytree, or None when any leaf was
+    donated away (mid-chunk failure: the consumed carries are gone).
+    Multi-host global arrays pass through as jax.Arrays for the
+    checkpoint writer to gather per host (checkpoint.host_view)."""
     if tree is None:
         return None
+    from scdna_replication_tools_tpu.infer import checkpoint as _ckpt
+
     try:
-        return jax.tree_util.tree_map(np.asarray, tree)
+        return _ckpt.host_view(tree)
     except Exception:  # pertlint: disable=PL011 — this IS the
         # deleted-buffer probe: None is the answer ("donated away"),
         # which the caller reports via the inexact_checkpoint event
@@ -846,9 +850,13 @@ def _emergency_save(checkpoint_cb, snap: dict) -> None:
             "diag_i0": int(snap.get("diag_i0", 0))
             if diag_np is not None else int(len(l_np)),
         }
+        # coordinated=False: a dying process must not enter the
+        # two-phase commit's barriers (its peers may be mid-chunk or
+        # already gone) — multi-process emergency saves write only this
+        # host's phase-1 shard; single-process saves are unaffected
         checkpoint_cb(params=p_np, opt_state=o_np, losses=l_np,
                       num_iters=int(len(l_np)), state=state,
-                      exact=o_np is not None)
+                      exact=o_np is not None, coordinated=False)
     except Exception as exc:  # noqa: BLE001 — the original abort must
         # surface, not a failed rescue save
         from scdna_replication_tools_tpu.utils.profiling import logger
@@ -899,9 +907,13 @@ def _chunk_loop(*, run_chunk, params, opt_state, losses, diag, i_host,
         if checkpoint_cb is not None and checkpoint_every \
                 and chunks_done and losses_np is not None \
                 and chunks_done % int(checkpoint_every) == 0:
+            from scdna_replication_tools_tpu.infer import (
+                checkpoint as _ckpt,
+            )
+
             checkpoint_cb(
-                params=jax.tree_util.tree_map(np.asarray, params),
-                opt_state=jax.tree_util.tree_map(np.asarray, opt_state),
+                params=_ckpt.host_view(params),
+                opt_state=_ckpt.host_view(opt_state),
                 losses=losses_np[:i_host], num_iters=i_host,
                 state={
                     "reseeds": reseeds, "extra_granted": extra_granted,
@@ -911,7 +923,7 @@ def _chunk_loop(*, run_chunk, params, opt_state, losses, diag, i_host,
                     "prev_verdict": prev_verdict,
                     "best_loss": best_loss, "best_it": best_it,
                     "best_params": _np_tree_or_none(best_params),
-                    "diag": np.asarray(diag), "diag_i0": diag_i0,
+                    "diag": _ckpt.host_view(diag), "diag_i0": diag_i0,
                 }, exact=True)
 
         # injection site at the top of the loop: every carry is a live
@@ -1058,7 +1070,7 @@ def _save_escalation_checkpoint(escalate_dir, tag, params, losses,
     try:
         from scdna_replication_tools_tpu.infer import checkpoint as ckpt
 
-        params_np = jax.tree_util.tree_map(np.asarray, params)
+        params_np = ckpt.host_view(params)
         return ckpt.save_step(str(escalate_dir), f"{tag}_nan", params_np,
                               np.asarray(losses), num_iters=num_iters,
                               converged=False, nan_abort=True)

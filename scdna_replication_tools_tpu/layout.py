@@ -60,6 +60,20 @@ def bin_spec(cells: str, lx: Optional[str]) -> P:
     return P(cells, lx)
 
 
+def replicated_spec() -> P:
+    """Spec of a fully-replicated tensor (global scalars, optimizer
+    step counts, unknown opt-state leaves)."""
+    return P()
+
+
+def scalar_block_spec() -> P:
+    """Spec of a rank-0 operand routed through a shard_map boundary as
+    a replicated ``(1, 1)`` block — models.pert._shard_map's pre-0.6
+    ``custom_vjp`` workaround (a rank-0 forwarded value has no axis to
+    concatenate over the mesh)."""
+    return P(None, None)
+
+
 def state_major_spec(cells: str, lx: Optional[str]) -> P:
     """Spec of a STATE-MAJOR (P, cells, loci) tensor: the state axis is
     tiny (P=13) and never sharded."""
@@ -247,6 +261,63 @@ _SHARD_MAP_DIMS = {
         ("cells", "loci"),
     ),
 }
+
+
+def param_cells_axis(name: str) -> Optional[int]:
+    """Index of the CELLS axis in parameter ``name``'s canonical layout,
+    or None when the parameter has no cells axis (global/replicated).
+
+    This is the machine-readable face of ``_PARAM_DIMS`` that the
+    topology-portable checkpoint layer (infer/checkpoint.py) uses to
+    slice/assemble per-cell leaves across host counts — the same table
+    the DP006/DP007 contract checker enumerates, so checkpointing can
+    never disagree with placement about which axis is which.  Unknown
+    names return None (treated as replicated — the safe default for
+    ad-hoc test pytrees)."""
+    dims = _PARAM_DIMS.get(name)
+    if not dims:
+        return None
+    return dims.index("cells") if "cells" in dims else None
+
+
+def batch_cells_axis(name: str) -> Optional[int]:
+    """Index of the CELLS axis in PertBatch field ``name``'s layout, or
+    None for per-locus/global fields — the batch-side twin of
+    :func:`param_cells_axis` (parallel/distributed host slicing)."""
+    dims = _BATCH_DIMS.get(name)
+    if not dims:
+        return None
+    return dims.index("cells") if "cells" in dims else None
+
+
+def spec_to_json(spec: P) -> list:
+    """A PartitionSpec as a JSON-able list (axis name, tuple of names,
+    or None per dim) — the checkpoint topology stamp's serialisation."""
+    out = []
+    for entry in tuple(spec):
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, tuple):
+            out.append([str(e) for e in entry])
+        else:
+            out.append(str(entry))
+    return out
+
+
+def param_layouts(lx: Optional[str] = None) -> dict:
+    """Per-parameter layout record for the checkpoint topology stamp:
+    ``name -> {"spec": json-able PartitionSpec, "dims": symbolic shape,
+    "cells_axis": int-or-None}``, derived from the same factories the
+    DP006/DP007 contract covers."""
+    specs = param_specs(lx)
+    return {
+        name: {
+            "spec": spec_to_json(spec),
+            "dims": list(_PARAM_DIMS.get(name, ())),
+            "cells_axis": param_cells_axis(name),
+        }
+        for name, spec in specs.items()
+    }
 
 
 @dataclasses.dataclass(frozen=True)

@@ -497,18 +497,58 @@ def _joint_logits(P, reads, u, omega, log_pi, phi, lamb, log_lamb,
     return log_pi[..., :, None] + bern[..., None, :] + nb
 
 
-def _shard_mapped(kernel_fn, mesh, specs, interpret):
-    """shard_map a Pallas kernel wrapper over the mesh with layout specs.
+def _shard_map(fn, *, mesh, in_specs, out_specs):
+    """``shard_map`` across jax versions, replication checks off.
 
-    check_vma is skipped because pallas_call's out_shape carries no
-    varying-mesh-axes info (the ops are pointwise over cells)."""
+    jax >= 0.6 exposes the public ``jax.shard_map`` (kwarg
+    ``check_vma``); earlier releases only have
+    ``jax.experimental.shard_map.shard_map`` (kwarg ``check_rep``).
+    The check is skipped either way because pallas_call's out_shape
+    carries no varying-mesh-axes/replication info (the ops are
+    pointwise over cells).
+
+    Rank-0 operands (the fixed ``lamb`` scalar, spec ``P()``) are
+    routed through the boundary as replicated ``(1, 1)`` blocks: with
+    ``check_rep=False`` the pre-0.6 transpose machinery cannot carry a
+    rank-0 value across the boundary of a ``custom_vjp`` — every
+    residual/forwarded value becomes an output of the forward program,
+    and a rank-0 output has no axis to concatenate over the mesh
+    (``_SpecError``).  The kernels are shape-agnostic about ``lamb``
+    (``ops/enum_kernel._scalars`` reshapes to ``()``), so the inner
+    function receives the block unchanged; done on every jax version so
+    one traced program shape serves all of them."""
+    from scdna_replication_tools_tpu.layout import scalar_block_spec
+
+    scalar = tuple(len(tuple(s)) == 0 for s in in_specs)
+    if any(scalar):
+        specs2 = tuple(scalar_block_spec() if sc else s
+                       for sc, s in zip(scalar, in_specs))
+        inner = _shard_map(fn, mesh=mesh, in_specs=specs2,
+                           out_specs=out_specs)
+
+        def outer(*args):
+            return inner(*(jnp.reshape(a, (1, 1)) if sc else a
+                           for sc, a in zip(scalar, args)))
+
+        return outer
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
+def _shard_mapped(kernel_fn, mesh, specs, interpret):
+    """shard_map a Pallas kernel wrapper over the mesh with layout
+    specs (see :func:`_shard_map` for the version/check handling)."""
     in_specs, out_specs = specs
-    return jax.shard_map(
+    return _shard_map(
         functools.partial(kernel_fn, interpret=interpret),
         mesh=mesh,
         in_specs=in_specs,
         out_specs=out_specs,
-        check_vma=False,
     )
 
 
@@ -633,8 +673,8 @@ def _enum_bin_loglik_fused_binary(spec, reads, u, omega, zbin_t, phi,
                                         lamb_, spec.P, interpret)
 
     in_specs, out_specs = fused_binary_shard_specs(mesh)
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_vma=False)(
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs)(
         reads, mu, zbin_t, phi, etas_t, lamb)
 
 
@@ -665,8 +705,8 @@ def _enum_bin_loglik_fused_sparse_binary(spec, reads, u, omega, zbin_t,
                                                spec.P, interpret)
 
     in_specs, out_specs = fused_sparse_binary_shard_specs(mesh)
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_vma=False)(
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs)(
         reads, mu, zbin_t, phi, eta_idx, eta_w, lamb)
 
 

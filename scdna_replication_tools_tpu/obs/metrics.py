@@ -375,6 +375,11 @@ class MetricsRegistry:
             self.counter("pert_degrades_total",
                          labels={"action": str(payload.get("action"))}
                          ).inc()
+            if payload.get("action") == "mesh_shrink":
+                self.counter("pert_mesh_shrinks_total").inc()
+        elif event == "resume":
+            if payload.get("resharded"):
+                self.counter("pert_resume_reshard_total").inc()
         elif event == "checkpoint":
             if payload.get("action") == "save":
                 self.counter("pert_checkpoint_saves_total").inc()

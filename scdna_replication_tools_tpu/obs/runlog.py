@@ -38,11 +38,15 @@ from scdna_replication_tools_tpu.obs import metrics as _metrics
 from scdna_replication_tools_tpu.utils import profiling
 from scdna_replication_tools_tpu.utils.profiling import logger
 
-SCHEMA_VERSION = 5  # v5: metrics_snapshot (the typed metrics registry's
-# phase-boundary export, obs/metrics.py); v4 added durability events
-# (fault_injected, retry, degrade, resume — the fault-tolerance layer's
-# audit trail); v3 control_decision (adaptive fit controller); v2 the
-# model-health events (fit_health, cell_qc_summary)
+SCHEMA_VERSION = 6  # v6: topology-portable durable runs — `hostloss`
+# fault kind + per-rule process scope, `degrade mesh_shrink` (the
+# elastic recovery rung, with before/after topology) and the resume
+# event's reshard trail (resharded + from/to topology); v5
+# metrics_snapshot (the typed metrics registry's phase-boundary
+# export, obs/metrics.py); v4 added durability events (fault_injected,
+# retry, degrade, resume — the fault-tolerance layer's audit trail);
+# v3 control_decision (adaptive fit controller); v2 the model-health
+# events (fit_health, cell_qc_summary)
 
 
 def _json_safe(value):

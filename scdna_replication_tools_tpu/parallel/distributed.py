@@ -59,19 +59,131 @@ def init_distributed(coordinator_address: Optional[str] = None,
     Idempotent: a second call is a no-op.
     """
     global _initialized
-    if _initialized or jax.process_count() > 1:
-        # already initialised (the process_count check alone would miss a
-        # 1-process slice that DID initialize — re-initialising raises)
+    if _initialized:
         return jax.process_count()
+    try:
+        # externally-initialised runtime (launcher called
+        # jax.distributed.initialize itself)?  Probe the distributed
+        # client directly: jax.process_count() would INITIALISE the
+        # backend as a side effect, after which initialize() refuses
+        # to run ("must be called before any JAX computations")
+        from jax._src import distributed as _jdist
+
+        if getattr(_jdist.global_state, "client", None) is not None:
+            _initialized = True
+            return jax.process_count()
+    except Exception:  # pertlint: disable=PL011 — a jax build without
+        # the private module just means nobody initialised it yet
+        pass
     if not auto and coordinator_address is None \
             and num_processes in (None, 1):
         return 1  # single-process: nothing to do
+    try:
+        # CPU backends need an explicit cross-process collectives
+        # implementation (XLA:CPU's default cannot run multiprocess
+        # computations) — gloo is what makes the 2-process chaos-smoke
+        # scenario runnable on a laptop/CI box.  Real TPU/GPU backends
+        # ignore the option; jax builds without it skip it.
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # pertlint: disable=PL011 — the option not
+        # existing in this jax build IS the answer; TPU paths never
+        # needed it
+        pass
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id)
     _initialized = True
     return jax.process_count()
+
+
+def process_rank_and_count() -> "tuple[int, int]":
+    """``(process_index, process_count)`` of the live jax runtime, or
+    ``(0, 1)`` when it cannot be asked — the ONE copy of the
+    single-process fallback probe (the manifest identity, checkpoint
+    save/load, fault scoping and the runner's resume gate all share
+    it, so the fallback policy can never drift between them)."""
+    try:
+        return int(jax.process_index()), int(jax.process_count())
+    except Exception:  # pertlint: disable=PL011 — an unaskable
+        # runtime means rank 0 of 1 by definition
+        return 0, 1
+
+
+def barrier(name: str) -> None:
+    """Cross-host synchronisation point (no-op single-process).
+
+    The two-phase checkpoint commit (infer/checkpoint.py) stands on
+    this: every host fsyncs its shard file BEFORE the barrier, process
+    0 commits the manifest pointer only AFTER it — so a preemption
+    anywhere in the window leaves either the previous complete
+    checkpoint or a fully-written new one visible, never a mix.
+    """
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
+def process_topology(mesh=None) -> dict:
+    """JSON-able description of the current execution topology — the
+    checkpoint topology stamp's process/device half (the mesh half is
+    ``parallel.mesh.mesh_topology``)."""
+    from scdna_replication_tools_tpu.parallel.mesh import mesh_topology
+
+    try:
+        device_kind = str(jax.devices()[0].device_kind)
+    except Exception:  # pertlint: disable=PL011 — an uninitialised
+        # backend has no device kind to report
+        device_kind = "unknown"
+    return {
+        "process_count": int(jax.process_count()),
+        "process_index": int(jax.process_index()),
+        "num_devices": int(jax.device_count()),
+        "device_kind": device_kind,
+        "mesh_axes": mesh_topology(mesh),
+    }
+
+
+def slice_cells_axis(val, axis: int, shard: HostShard) -> np.ndarray:
+    """This host's rows of one leaf along its cells axis — the single
+    copy of the layout-contract-sensitive host slice shared by batch,
+    parameter and optimizer-state slicing."""
+    arr = np.asarray(val)
+    idx = [slice(None)] * arr.ndim
+    idx[axis] = slice(shard.lo, shard.hi)
+    return arr[tuple(idx)]
+
+
+def slice_local_batch(local_or_global_batch: PertBatch,
+                      shard: HostShard) -> PertBatch:
+    """This host's cells-rows of a fully-loaded PertBatch.
+
+    Bridge for runners whose loader materialises the whole batch on
+    every host (the current single-process loader): slice the rows
+    ``shard`` assigns to this host before ``shard_batch_multihost``
+    re-places them.  Which axis is the cells axis comes from
+    ``layout.batch_cells_axis`` — the same table placement uses.
+    """
+    out = {}
+    for name in layout._BATCH_DIMS:
+        val = getattr(local_or_global_batch, name)
+        axis = layout.batch_cells_axis(name)
+        out[name] = val if val is None or axis is None \
+            else slice_cells_axis(val, axis, shard)
+    return PertBatch(**out)
+
+
+def slice_local_params(params: dict, shard: HostShard) -> dict:
+    """This host's cells-rows of a full parameter pytree (per-cell
+    leaves sliced via ``layout.param_cells_axis``; globals passed
+    through) — the parameter twin of :func:`slice_local_batch`."""
+    out = {}
+    for name, val in params.items():
+        axis = layout.param_cells_axis(name)
+        out[name] = val if val is None or axis is None \
+            else slice_cells_axis(val, axis, shard)
+    return out
 
 
 def global_mesh(cell_shards: Optional[int] = None,

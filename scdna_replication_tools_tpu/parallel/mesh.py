@@ -104,10 +104,62 @@ def loci_axis(mesh: Mesh) -> Optional[str]:
     return LOCI_AXIS if LOCI_AXIS in mesh.axis_names else None
 
 
+def mesh_topology(mesh: Optional[Mesh]) -> dict:
+    """JSON-able axis-name -> extent description of a mesh (``{}`` for
+    no mesh / single device) — the shared vocabulary of the checkpoint
+    topology stamp, the ``degrade mesh_shrink`` audit events and the
+    ``resume`` reshard trail."""
+    if mesh is None:
+        return {}
+    return {str(k): int(v) for k, v in mesh.shape.items()}
+
+
+def shrink_mesh(mesh: Mesh) -> Optional[Mesh]:
+    """One rung of the elastic mesh-shrink ladder: the same axis names
+    over HALF the cells extent (the loci extent is preserved while the
+    remaining devices allow it, then collapses to 1), or None when the
+    mesh is already minimal (1x1 — the next rung is single-device /
+    abort).  Built from ``jax.devices()`` so a rebuilt mesh only ever
+    claims devices the runtime still reports."""
+    cells = int(mesh.shape[CELLS_AXIS])
+    lx = loci_axis(mesh)
+    ln = int(mesh.shape[lx]) if lx is not None else 1
+    if cells <= 1 and ln <= 1:
+        return None
+    new_cells = max(1, cells // 2)
+    new_ln = ln
+    if new_cells * new_ln > max(1, len(jax.devices())) or cells <= 1:
+        # not enough healthy devices for the preserved loci extent (or
+        # the cells axis is exhausted): collapse the loci axis too
+        new_ln = 1
+    if new_cells == cells and new_ln == ln:
+        return None
+    return make_mesh(new_cells, loci_shards=new_ln)
+
+
 def _put(mesh: Mesh, x, spec):
     if x is None:
         return None
     return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def replicate_fixed(mesh: Mesh, fixed: dict) -> dict:
+    """Commit the conditioning dict (step-2/3 ``fixed``) onto THIS mesh,
+    fully replicated.
+
+    The fixed leaves are global scalars/vectors (beta_means, lamb, a,
+    the optional per-locus rho) — replication matches what sharding
+    propagation always chose for them.  The call matters on a mesh
+    CHANGE: the elastic shrink rung re-enters the fit inside one
+    process, and a conditioning dict still committed to the previous
+    (larger) mesh would collide with the re-placed params at trace time
+    ("incompatible devices").  On an unchanged mesh the device_put is
+    an identity.  rho deliberately replicates rather than sharding over
+    loci: it keeps the compiled program's reduction geometry identical
+    to the uncommitted-input placement every parity artifact was
+    recorded under.
+    """
+    return {k: _put(mesh, v, layout.P()) for k, v in fixed.items()}
 
 
 def shard_batch(mesh: Mesh, batch: PertBatch) -> PertBatch:

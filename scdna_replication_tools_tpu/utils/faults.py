@@ -18,13 +18,15 @@ for.  This module provides both halves of that story:
   global ``is None`` check — provably inert;
 
 * the **exception taxonomy** (:func:`classify_exception`): maps an
-  exception to ``preemption`` / ``oom`` / ``hang`` / ``transient`` /
-  ``deterministic``, which is the whole policy input of the recovery
-  ladder in ``infer/runner.py`` — transient errors get bounded
-  exponential backoff (:func:`retry_call`), OOM walks the degradation
-  ladder, preemptions and hangs abort with a resumable checkpoint,
-  deterministic errors propagate untouched (retrying a real bug only
-  hides it);
+  exception to ``preemption`` / ``oom`` / ``hang`` / ``hostloss`` /
+  ``transient`` / ``deterministic``, which is the whole policy input
+  of the recovery ladder in ``infer/runner.py`` — transient errors get
+  bounded exponential backoff (:func:`retry_call`), OOM walks the
+  degradation ladder, host/device loss in a sharded fit walks the
+  ELASTIC rung (rebuild a smaller mesh, re-place the last checkpoint,
+  continue — audited as ``degrade mesh_shrink``), preemptions and
+  hangs abort with a resumable checkpoint, deterministic errors
+  propagate untouched (retrying a real bug only hides it);
 
 * a **watchdog** (:func:`run_with_deadline`): runs a blocking call in
   a daemon thread with a hard deadline, converting a hang (a compile
@@ -40,17 +42,29 @@ Fault spec grammar (comma-separated rules)::
     KIND@SITE#N-M        fire on hits N..M inclusive
     KIND@SITE#*          fire on every hit
     hang@SITE#N:SECS     the hang kind takes a sleep duration
+    KIND@SITE#N@procK    fire only in process K (multi-host chaos)
+    KIND@SITE@proc*      fire in every process (explicit; the default)
 
 with KIND one of ``preempt`` (raises :class:`SimulatedPreemption`),
 ``oom`` (raises :class:`SimulatedResourceExhausted`), ``transient``
 (raises :class:`SimulatedTransientError` — exercises the
-retry-resumes-from-checkpoint ladder), ``nan`` (returned to the
-caller, which poisons the chunk so the REAL NaN-escalation machinery
-runs), ``corrupt`` (returned to the checkpoint writer, which truncates
-the file it just wrote), ``hang`` (sleeps ``SECS``, default 30 — long
-enough to trip any configured watchdog).  Example::
+retry-resumes-from-checkpoint ladder), ``hostloss`` (raises
+:class:`SimulatedHostLoss` — a lost host/device in the mesh, which
+drives the elastic mesh-shrink rung of the recovery ladder), ``nan``
+(returned to the caller, which poisons the chunk so the REAL
+NaN-escalation machinery runs), ``corrupt`` (returned to the
+checkpoint writer, which truncates the file it just wrote), ``hang``
+(sleeps ``SECS``, default 30 — long enough to trip any configured
+watchdog).  Examples::
 
     --faults 'preempt@step2/chunk#2,corrupt@step2/save'
+    --faults 'preempt@step2/chunk#2@proc1'   # kill only host 1
+
+The ``@procK`` scope is what makes multi-host chaos runs surgical:
+hit counting stays per-site within each process (every process runs
+the same deterministic schedule), but the rule fires only where its
+scope says — so a 2-host chaos scenario can preempt exactly one host
+while the other survives to the barrier.
 
 Site names are stable strings owned by the call sites:
 ``{step}/start``, ``{step}/chunk``, ``{step}/save``, ``{step}/end``,
@@ -68,9 +82,24 @@ from typing import Callable, Dict, List, Optional
 
 from scdna_replication_tools_tpu.utils.profiling import logger
 
-FAULT_KINDS = ("preempt", "oom", "nan", "corrupt", "hang", "transient")
+FAULT_KINDS = ("preempt", "oom", "nan", "corrupt", "hang", "transient",
+               "hostloss")
 
 ENV_VAR = "PERT_FAULTS"
+
+
+def _process_index() -> int:
+    """This process's rank for ``@procK``-scoped rules; 0 when jax is
+    absent or uninitialised (single-process is rank 0 either way)."""
+    try:
+        from scdna_replication_tools_tpu.parallel.distributed import (
+            process_rank_and_count,
+        )
+
+        return process_rank_and_count()[0]
+    except Exception:  # pertlint: disable=PL011 — faults must stay
+        # importable/usable without the jax-coupled parallel layer
+        return 0
 
 
 class SimulatedPreemption(BaseException):
@@ -114,6 +143,23 @@ class SimulatedTransientError(ConnectionError):
         self.site = site
 
 
+class SimulatedHostLoss(RuntimeError):
+    """A simulated lost host/device in the mesh (a TPU worker VM dying
+    under a sharded fit while THIS process survives).  Unlike a
+    preemption (the whole process is going away) the surviving
+    processes can keep working on a SMALLER mesh — this is the fault
+    the elastic mesh-shrink rung of the recovery ladder exists for.
+    The message carries the ``DATA_LOSS`` marker so the simulated
+    fault exercises exactly the classification path a real device-loss
+    status takes."""
+
+    def __init__(self, site: str, hit: int):
+        super().__init__(
+            f"DATA_LOSS: simulated host/device loss at {site} "
+            f"(hit {hit})")
+        self.site = site
+
+
 class WatchdogTimeout(RuntimeError):
     """A watchdog deadline fired: the wrapped call is presumed hung."""
 
@@ -138,9 +184,14 @@ class FaultRule:
     first: int = 1   # 1-based hit range [first, last]; last=None => open
     last: Optional[int] = 1
     arg: Optional[float] = None   # hang duration
+    proc: Optional[int] = None    # @procK scope; None = every process
 
-    def matches(self, site: str, hit: int) -> bool:
+    def matches(self, site: str, hit: int,
+                proc: Optional[int] = None) -> bool:
         if site != self.site or hit < self.first:
+            return False
+        if self.proc is not None and proc is not None \
+                and proc != self.proc:
             return False
         return self.last is None or hit <= self.last
 
@@ -154,6 +205,25 @@ def _parse_rule(token: str) -> FaultRule:
     if kind not in FAULT_KINDS:
         raise ValueError(f"fault rule {token!r}: unknown kind {kind!r} "
                          f"(one of {', '.join(FAULT_KINDS)})")
+    proc: Optional[int] = None
+    if "@" in rest:
+        # trailing process scope: KIND@SITE[#N][:ARG]@procK / @proc*
+        rest, scope = rest.rsplit("@", 1)
+        scope = scope.strip().lower()
+        if not scope.startswith("proc"):
+            raise ValueError(
+                f"fault rule {token!r}: trailing @{scope!r} is not a "
+                f"process scope (expected @procK or @proc*)")
+        which = scope[len("proc"):]
+        if which != "*":
+            try:
+                proc = int(which)
+            except ValueError:
+                raise ValueError(
+                    f"fault rule {token!r}: bad process scope "
+                    f"@{scope!r} (expected @procK or @proc*)") from None
+        # '*' = every process: identical to no scope, kept in the
+        # grammar so multi-host specs can SAY it explicitly
     arg = None
     if ":" in rest:
         rest, arg_s = rest.rsplit(":", 1)
@@ -172,7 +242,8 @@ def _parse_rule(token: str) -> FaultRule:
     site = rest.strip()
     if not site:
         raise ValueError(f"fault rule {token!r}: empty site")
-    return FaultRule(kind=kind, site=site, first=first, last=last, arg=arg)
+    return FaultRule(kind=kind, site=site, first=first, last=last, arg=arg,
+                     proc=proc)
 
 
 class FaultPlan:
@@ -200,18 +271,29 @@ class FaultPlan:
     def fired(self) -> List[dict]:
         return list(self._fired)
 
-    def check(self, site: str) -> Optional[FaultRule]:
+    def check(self, site: str,
+              proc: Optional[int] = None) -> Optional[FaultRule]:
         """Count one hit of ``site``; return the matching rule, if any.
 
         Counting is per-site and lock-protected (the watchdog thread may
         race the main thread at a site); the FIRST matching rule wins.
+        ``proc`` is this process's rank for ``@procK``-scoped rules —
+        the COUNT advances in every process (all processes run the same
+        deterministic schedule), only the firing is scoped.  When the
+        caller does not pass it (the pre-scope ``check(site)``
+        signature), the LIVE rank is resolved here — a scoped rule must
+        never silently degrade to ``@proc*`` through an old call site.
         """
+        if proc is None:
+            proc = _process_index()
         with self._lock:
             hit = self._hits.get(site, 0) + 1
             self._hits[site] = hit
         for rule in self.rules:
-            if rule.matches(site, hit):
+            if rule.matches(site, hit, proc):
                 record = {"site": site, "kind": rule.kind, "hit": hit}
+                if rule.proc is not None:
+                    record["proc"] = int(rule.proc)
                 self._fired.append(record)
                 return rule
         return None
@@ -265,7 +347,7 @@ def point(site: str) -> Optional[str]:
     plan = _ACTIVE
     if plan is None:
         return None
-    rule = plan.check(site)
+    rule = plan.check(site, proc=_process_index())
     if rule is None:
         return None
     hit = plan._hits[site]
@@ -283,6 +365,8 @@ def point(site: str) -> Optional[str]:
         raise SimulatedResourceExhausted(site, hit)
     if rule.kind == "transient":
         raise SimulatedTransientError(site, hit)
+    if rule.kind == "hostloss":
+        raise SimulatedHostLoss(site, hit)
     if rule.kind == "hang":
         time.sleep(rule.arg if rule.arg is not None else 30.0)
         return "hang"
@@ -315,15 +399,23 @@ _TRANSIENT_MARKERS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED",
                       "connection reset", "Connection reset",
                       "Broken pipe", "socket closed", "EOF detected",
                       "failed to connect")
+# a lost host/device in the mesh: the XLA/gRPC statuses a dying TPU
+# worker surfaces to its SURVIVING peers (DATA_LOSS, halted-system
+# prose) — distinct from `transient` because retrying on the same mesh
+# cannot succeed; the elastic rung rebuilds a smaller one instead
+_HOSTLOSS_MARKERS = ("DATA_LOSS", "device lost", "Device lost",
+                     "system has halted", "slice health",
+                     "worker has been restarted")
 
 
 def classify_exception(exc: BaseException) -> str:
     """Map an exception to the recovery ladder's vocabulary.
 
-    Returns one of ``preemption`` / ``oom`` / ``hang`` / ``transient``
-    / ``deterministic``.  The default is ``deterministic``: retrying an
-    unrecognised error hides real bugs, so anything not positively
-    identified as recoverable propagates untouched.
+    Returns one of ``preemption`` / ``oom`` / ``hang`` / ``hostloss``
+    / ``transient`` / ``deterministic``.  The default is
+    ``deterministic``: retrying an unrecognised error hides real bugs,
+    so anything not positively identified as recoverable propagates
+    untouched.
     """
     if isinstance(exc, SimulatedPreemption) \
             or isinstance(exc, KeyboardInterrupt):
@@ -331,6 +423,9 @@ def classify_exception(exc: BaseException) -> str:
     if isinstance(exc, WatchdogTimeout):
         return "hang"
     text = f"{type(exc).__name__}: {exc}"
+    if isinstance(exc, SimulatedHostLoss) \
+            or any(m in text for m in _HOSTLOSS_MARKERS):
+        return "hostloss"
     if isinstance(exc, MemoryError) \
             or any(m in text for m in _OOM_MARKERS):
         return "oom"

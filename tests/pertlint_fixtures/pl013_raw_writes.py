@@ -1,0 +1,62 @@
+"""Fixture for PL013 (raw-checkpoint-write) — parsed, never imported."""
+import io
+
+import numpy as np
+
+
+def bad_direct_savez(path, params):
+    np.savez(path, **params)  # expect: PL013
+
+
+def bad_savez_compressed(path, arr):
+    np.savez_compressed(path, arr=arr)  # expect: PL013
+
+
+def bad_np_save(path, arr):
+    np.save(path, arr)  # expect: PL013
+
+
+def bad_binary_open(path, blob):
+    with open(path, "wb") as fh:  # expect: PL013
+        fh.write(blob)
+
+
+def bad_binary_append(path, blob):
+    fh = open(path, mode="ab")  # expect: PL013
+    fh.write(blob)
+    fh.close()
+
+
+def good_serialise_to_memory(params):
+    # the sanctioned idiom: serialise in memory, commit atomically
+    buf = io.BytesIO()
+    np.savez(buf, **params)
+    return buf.getvalue()
+
+
+def good_bytesio_inline(params):
+    np.savez(io.BytesIO(), **params)
+
+
+def good_text_write(path, text):
+    # text-mode writes are not durability-bearing artifacts
+    with open(path, "w") as fh:
+        fh.write(text)
+
+
+def good_binary_read(path):
+    with open(path, "rb") as fh:
+        return fh.read()
+
+
+def good_nonliteral_mode(path, mode, blob):
+    # a non-literal mode cannot be judged statically
+    with open(path, mode) as fh:
+        fh.write(blob)
+
+
+def deliberate_raw_write(path, blob):
+    # e.g. a scratch diagnostic dump that is never resumed from
+    with open(path, "wb") as fh:  # pertlint: disable=PL013 — scratch
+        # dump, no resume path reads it
+        fh.write(blob)

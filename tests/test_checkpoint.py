@@ -109,9 +109,12 @@ def test_sharded_partial_fit_resume_is_exact(tmp_path, synthetic_frames):
     trajectory.
     """
     s, g1, clone_idx = _dense_inputs(synthetic_frames)
-    full, half = 60, 30
+    # budgets are wall-budget-trimmed, not accuracy-tuned: the invariant
+    # (bit-exact sharded resume) is budget-independent, and the
+    # interpreted kernel makes every sharded iteration expensive on CPU
+    full, half = 40, 20
     base = dict(cn_prior_method="g1_clones", rel_tol=0.0, run_step3=False,
-                max_iter_step1=20, min_iter_step1=20, num_shards=8,
+                max_iter_step1=10, min_iter_step1=10, num_shards=8,
                 enum_impl="pallas_interpret")
 
     inf_a = PertInference(s, g1,

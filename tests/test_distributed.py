@@ -34,11 +34,22 @@ def test_host_shard_bounds():
 
 
 def test_multihost_placement_matches_single_host_fit():
+    import jax.numpy as jnp
+
     spec, params, fixed, batch = _toy_problem(num_cells=16, num_loci=64,
                                               enum_impl="pallas_interpret",
                                               sparse=True)
     mesh = global_mesh(4, loci_shards=2)
     shard = HostShard.for_this_process(16)
+
+    def fresh_params():
+        # fit_map DONATES params0, and jax.device_put of an
+        # already-committed array can return the SAME zero-copy buffer
+        # (the PR-4 aliasing class) — so placing the one `params` dict
+        # twice would hand the second run deleted buffers.  Each run
+        # places its own fresh copies, per fit_map's documented
+        # donation contract.
+        return {k: jnp.array(v, copy=True) for k, v in params.items()}
 
     def run(b, p):
         def loss_fn(p_, fixed_, b_):
@@ -47,7 +58,7 @@ def test_multihost_placement_matches_single_host_fit():
                       learning_rate=5e-2)
         return np.asarray(fit.losses, np.float64)
 
-    ref = run(shard_batch(mesh, batch), shard_params(mesh, params))
+    ref = run(shard_batch(mesh, batch), shard_params(mesh, fresh_params()))
     got = run(shard_batch_multihost(mesh, batch, shard),
-              shard_params_multihost(mesh, params, shard))
+              shard_params_multihost(mesh, fresh_params(), shard))
     np.testing.assert_allclose(got, ref, rtol=1e-6)

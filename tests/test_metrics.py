@@ -422,8 +422,10 @@ def test_fleet_index_and_query(same_seed_pair, tmp_path, capsys):
                             "--out", str(out)]) == 0
     doc = json.loads(out.read_text())
     assert doc["kind"] == "pert_fleet_index" and doc["num_runs"] == 2
+    from scdna_replication_tools_tpu.obs import SCHEMA_VERSION
+
     for record in doc["runs"]:
-        assert record["schema_version"] == 5
+        assert record["schema_version"] == SCHEMA_VERSION
         assert record["metrics"]["pert_fit_iters_total"] == 24
         assert record["workload"]["num_cells"] is not None
     # query by the (shared) config hash finds both; a bogus hash none

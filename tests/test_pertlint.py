@@ -46,6 +46,7 @@ FIXTURES = {
     "PL010": FIXTURE_DIR / "pl010_control_actions.py",
     "PL011": FIXTURE_DIR / "pl011_swallowed.py",
     "PL012": FIXTURE_DIR / "pl012_metric_names.py",
+    "PL013": FIXTURE_DIR / "pl013_raw_writes.py",
 }
 
 
@@ -197,6 +198,8 @@ def _seed_violation(rule_id):
                   "    except Exception:\n        return None\n"),
         "PL012": ("\ndef seeded(metrics):\n"
                   "    metrics.counter('pert_bogus_total').inc()\n"),
+        "PL013": ("\ndef seeded(path, arr):\n"
+                  "    np.savez(path, arr=arr)\n"),
     }[rule_id]
 
 

@@ -123,6 +123,13 @@ def test_classify_exception_taxonomy():
                             "lost")) == "transient"
     assert cls(ConnectionResetError("peer")) == "transient"
     assert cls(TimeoutError()) == "transient"
+    # a dying TPU worker's status in its surviving peers is hostloss,
+    # not transient: retrying on the same mesh cannot succeed — the
+    # elastic rung rebuilds a smaller one instead
+    assert cls(RuntimeError("DATA_LOSS: checkpoint shard gone")) \
+        == "hostloss"
+    assert cls(RuntimeError("device lost: the system has halted")) \
+        == "hostloss"
     # the default is deterministic: retrying unknown errors hides bugs
     assert cls(ValueError("bad shape")) == "deterministic"
     assert cls(RuntimeError("some internal invariant")) == "deterministic"
@@ -245,6 +252,22 @@ def test_footerless_legacy_checkpoint_still_loads(tmp_path):
     pathlib.Path(path).write_bytes(blob[:-48])   # strip the footer
     params, _, _ = ckpt.load_step(str(tmp_path), "step2")
     assert float(params["tau_raw"][0]) == 1.0
+
+
+def test_single_process_emergency_save_is_a_normal_atomic_save(tmp_path):
+    """coordinate=False (the dying-process emergency path) only changes
+    MULTI-process behaviour (shard file, no commit — see
+    tests/test_topology_resume.py); single-process it must stay the
+    same atomic, footered, immediately-loadable file as ever."""
+    params = {"tau_raw": np.full(8, 7.0, np.float32)}
+    path = ckpt.save_step(str(tmp_path), "step2", params,
+                          np.array([3.0], np.float32), coordinate=False)
+    assert os.path.basename(path) == "pert_step2.npz"
+    loaded, _, extra = ckpt.load_step(str(tmp_path), "step2")
+    assert float(loaded["tau_raw"][0]) == 7.0
+    assert int(extra["meta.format_version"]) >= 4
+    # the topology stamp rides every save, emergency or not
+    assert isinstance(extra.get("meta.topology"), dict)
 
 
 # ---------------------------------------------------------------------------

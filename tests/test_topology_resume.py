@@ -1,0 +1,565 @@
+"""Topology-portable durable runs (ISSUE 13).
+
+The chaos matrix behind the tentpole: checkpoints stamped with their
+save-time topology (mesh axes, process count, per-leaf PartitionSpecs),
+two-phase-committed sharded generations whose crash windows can never
+expose a mixed or partial step to ``--resume auto``, reshard resume
+(any mesh restores onto any mesh — bit-exact when the reduction
+geometry is unchanged, parity-gated when it is not), the elastic
+mesh-shrink rung of the recovery ladder, process-scoped fault rules,
+and the multi-host manifest identity (per-host fingerprints + the
+deduplicated fingerprint-of-fingerprints).
+
+Fast shapes run in tier-1; the wider reshard matrix is ``slow``.
+"""
+
+import json
+import shutil
+
+import numpy as np
+import pytest
+
+from scdna_replication_tools_tpu.config import PertConfig
+from scdna_replication_tools_tpu.infer import checkpoint as ckpt
+from scdna_replication_tools_tpu.infer import manifest as manifest_mod
+from scdna_replication_tools_tpu.infer.runner import PertInference
+from scdna_replication_tools_tpu.obs.schema import validate_run
+from scdna_replication_tools_tpu.parallel import mesh as mesh_mod
+from scdna_replication_tools_tpu.utils import faults as faults_mod
+
+from conftest import dense_inputs_from_frames as _dense_inputs  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clear_fault_plan():
+    yield
+    faults_mod.install(None)
+
+
+# same budget discipline as test_resilience: controller ON, rel_tol=0,
+# bounded extensions — deterministic and CI-cheap (budgets sized to the
+# tier-1 wall: 3 chunks of 25, preempt lands at chunk #2)
+BASE = dict(cn_prior_method="g1_clones", rel_tol=0.0, run_step3=False,
+            max_iter=75, min_iter=25, max_iter_step1=20,
+            min_iter_step1=10, fit_diag_every=25,
+            controller_max_extra_iters=25, telemetry_path=None)
+# the MULTICHIP parity geometry: 4 cell shards x 2 loci shards over the
+# conftest-forced 8 host CPU devices
+MESH_4x2 = dict(num_shards=4, loci_shards=2)
+
+
+def _run_pipeline(synthetic_frames, config):
+    s, g1, clone_idx = _dense_inputs(synthetic_frames)
+    inf = PertInference(s, g1, config, clone_idx_s=clone_idx,
+                        clone_idx_g1=clone_idx, num_clones=2)
+    return inf, inf.run()
+
+
+def _events(path):
+    return [json.loads(line) for line in path.read_text().splitlines()]
+
+
+def _tau(fit_result):
+    return 1.0 / (1.0 + np.exp(-np.asarray(
+        fit_result.params["tau_raw"], np.float64)))
+
+
+def _assert_tau_parity(g_tau, r_tau, max_boundary_outliers=2):
+    """Cross-topology tau parity, honest about the mirror ambiguity.
+
+    tau and 1-tau parameterise the same replication state up to the
+    mirror symmetry (PYRO_PARITY.md), and a bistable BOUNDARY cell can
+    legitimately land in either basin when the reduction geometry
+    changes — the rescue's per-cell objective comparison is a
+    knife-edge there, and its sub-fit refits the flipped cell to a
+    fresh optimum.  So: every cell must match within 0.05 after
+    folding over the mirror, EXCEPT a bounded handful of outliers that
+    must each be boundary-extreme (tau < 0.05 or > 0.95) in the golden
+    arm — exactly the cells ``cell_qc`` flags as ``boundary_tau``.
+    UNfolded bit-equality remains the same-geometry contract."""
+    folded = np.minimum(np.abs(g_tau - r_tau),
+                        np.abs(g_tau - (1.0 - r_tau)))
+    outliers = folded >= 0.05
+    assert int(outliers.sum()) <= max_boundary_outliers, folded
+    assert np.all((g_tau[outliers] < 0.05) | (g_tau[outliers] > 0.95)), \
+        (g_tau[outliers], r_tau[outliers])
+
+
+# ---------------------------------------------------------------------------
+# process-scoped fault rules + hostloss
+# ---------------------------------------------------------------------------
+
+
+def test_fault_rule_process_scope_parsing():
+    plan = faults_mod.FaultPlan.from_spec(
+        "preempt@step2/chunk#2@proc1,oom@x@proc0,hostloss@y#3@proc*")
+    assert plan.rules[0].proc == 1 and plan.rules[0].first == 2
+    assert plan.rules[1].proc == 0
+    # '@proc*' is the explicit spelling of "every process"
+    assert plan.rules[2].proc is None and plan.rules[2].first == 3
+
+
+def test_fault_rule_bad_process_scope_rejected():
+    with pytest.raises(ValueError):
+        faults_mod.FaultPlan.from_spec("preempt@site@host1")
+    with pytest.raises(ValueError):
+        faults_mod.FaultPlan.from_spec("preempt@site@procX")
+
+
+def test_process_scoped_rule_fires_only_in_its_process():
+    spec = "preempt@s#2@proc1"
+    # rank 1 sees the fault at hit 2; rank 0 never does — but the hit
+    # COUNT advances identically in both (same deterministic schedule)
+    plan = faults_mod.FaultPlan.from_spec(spec)
+    assert plan.check("s", proc=1) is None
+    assert plan.check("s", proc=1).kind == "preempt"
+    plan0 = faults_mod.FaultPlan.from_spec(spec)
+    assert plan0.check("s", proc=0) is None
+    assert plan0.check("s", proc=0) is None
+    assert plan0.check("s", proc=0) is None
+    # the firing record carries the scope
+    assert plan.fired[0]["proc"] == 1
+
+
+def test_hostloss_kind_raises_and_classifies():
+    faults_mod.install(faults_mod.FaultPlan.from_spec("hostloss@z"))
+    with pytest.raises(faults_mod.SimulatedHostLoss) as exc_info:
+        faults_mod.point("z")
+    assert faults_mod.classify_exception(exc_info.value) == "hostloss"
+    # the real XLA statuses a dying peer surfaces classify the same way
+    assert faults_mod.classify_exception(
+        RuntimeError("DATA_LOSS: device lost")) == "hostloss"
+    # DATA_LOSS outranks the transient markers: retrying on the same
+    # mesh cannot succeed, the elastic rung must get it instead
+    assert faults_mod.classify_exception(
+        RuntimeError("DATA_LOSS: connection reset by peer")) == "hostloss"
+
+
+# ---------------------------------------------------------------------------
+# topology stamp round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_topology_stamp_roundtrip(tmp_path):
+    mesh = mesh_mod.make_mesh(4, loci_shards=2)
+    params = {"tau_raw": np.arange(24.0, dtype=np.float32)}
+    ckpt.save_step(str(tmp_path), "step2", params,
+                   np.zeros(3, np.float32), num_iters=3,
+                   converged=False, mesh=mesh)
+    _, _, extra = ckpt.load_step(str(tmp_path), "step2")
+    topo = extra["meta.topology"]
+    assert topo["mesh_axes"] == {"cells": 4, "loci": 2}
+    assert topo["process_count"] == 1
+    assert topo["num_devices"] >= 8
+    # per-leaf layout contract from layout.param_layouts: the big pi
+    # tensor is state-major with cells on axis 1
+    pi = topo["param_layouts"]["pi_logits"]
+    assert pi["cells_axis"] == 1
+    assert pi["dims"][pi["cells_axis"]] == "cells"
+    assert int(extra["meta.format_version"]) >= 4
+
+
+def test_unstamped_v3_checkpoint_still_loads(tmp_path):
+    """Pre-v4 files carry no stamp: geometry unknown, not an error."""
+    import io
+    import struct
+
+    flat = {"param.tau_raw": np.ones(4, np.float32),
+            "losses": np.zeros(2, np.float32),
+            "meta.format_version": np.asarray(3),
+            "meta.num_iters": np.asarray(2),
+            "meta.converged": np.asarray(False),
+            "meta.nan_abort": np.asarray(False)}
+    buf = io.BytesIO()
+    np.savez(buf, **flat)
+    payload = buf.getvalue()
+    import hashlib
+
+    footer = (b"PERTCK01" + struct.pack("<Q", len(payload))
+              + hashlib.sha256(payload).digest())
+    (tmp_path / "pert_step2.npz").write_bytes(payload + footer)
+    params, losses, extra = ckpt.load_step(str(tmp_path), "step2")
+    assert "meta.topology" not in extra
+    np.testing.assert_array_equal(params["tau_raw"], np.ones(4))
+
+
+# ---------------------------------------------------------------------------
+# two-phase commit: crash windows
+# ---------------------------------------------------------------------------
+
+
+def _host_flat(k, full, step="step2", iters=10):
+    """One simulated host's flat checkpoint mapping: its half of a
+    24-cell tau plus replicated meta — the exact sidecar layout
+    ``_flat_add`` emits for a multi-host global array."""
+    half = full[k * 12:(k + 1) * 12]
+    return {
+        f"param.tau_raw": np.asarray(half),
+        f"range.param.tau_raw": np.asarray([[k * 12, (k + 1) * 12]],
+                                           np.int64),
+        f"gshape.param.tau_raw": np.asarray([24], np.int64),
+        "losses": np.arange(iters, dtype=np.float32),
+        "meta.format_version": np.asarray(ckpt.CHECKPOINT_FORMAT_VERSION),
+        "meta.num_iters": np.asarray(iters),
+        "meta.converged": np.asarray(False),
+        "meta.nan_abort": np.asarray(False),
+        "meta.topology": np.asarray(json.dumps(ckpt.topology_stamp(None))),
+    }
+
+
+def _write_generation(ck, full, iters=10):
+    """Both hosts write, then host 0 commits (the barrier is a
+    single-process no-op here; the serialisation order mirrors the real
+    rendezvous: every shard exists before the commit pointer does)."""
+    ckpt._save_step_multiprocess(str(ck), "step2",
+                                 _host_flat(1, full, iters=iters),
+                                 2, 1, None)
+    ckpt._save_step_multiprocess(str(ck), "step2",
+                                 _host_flat(0, full, iters=iters),
+                                 2, 0, None)
+
+
+def test_sharded_generation_merges_across_hosts(tmp_path):
+    full = np.arange(24.0, dtype=np.float32)
+    _write_generation(tmp_path, full)
+    params, losses, extra = ckpt.load_step(str(tmp_path), "step2")
+    np.testing.assert_array_equal(params["tau_raw"], full)
+    assert int(extra["meta.num_iters"]) == 10
+    doc = json.loads((tmp_path / "pert_step2.commit.json").read_text())
+    assert doc["process_count"] == 2 and doc["seq"] == 1
+
+
+def test_uncommitted_generation_is_invisible(tmp_path):
+    """Crash between shard-write and manifest-commit: the new
+    generation's shard files exist but no commit points at them — the
+    PREVIOUS complete generation is what load_step sees."""
+    old = np.arange(24.0, dtype=np.float32)
+    _write_generation(tmp_path, old, iters=10)
+    # seq 2: host 1 wrote its shard, then the preemption hit before the
+    # barrier — no commit, and host 0's shard never landed
+    ckpt._save_step_multiprocess(str(tmp_path), "step2",
+                                 _host_flat(1, old + 100.0, iters=20),
+                                 2, 1, None)
+    params, _, extra = ckpt.load_step(str(tmp_path), "step2")
+    np.testing.assert_array_equal(params["tau_raw"], old)
+    assert int(extra["meta.num_iters"]) == 10
+
+
+def test_corrupt_committed_generation_falls_back_to_previous(tmp_path):
+    old = np.arange(24.0, dtype=np.float32)
+    new = old + 7.0
+    _write_generation(tmp_path, old, iters=10)
+    _write_generation(tmp_path, new, iters=20)
+    # the committed seq-2 generation loses a shard to corruption: the
+    # multi-file analog of the .prev fallback restores seq 1
+    shard = tmp_path / "pert_step2.s2.p1of2.npz"
+    shard.write_bytes(shard.read_bytes()[:100])
+    params, _, extra = ckpt.load_step(str(tmp_path), "step2")
+    np.testing.assert_array_equal(params["tau_raw"], old)
+    assert int(extra["meta.num_iters"]) == 10
+
+
+def test_emergency_save_is_uncoordinated(tmp_path, monkeypatch):
+    """A dying process saves phase 1 only: its shard file, no barrier,
+    no commit — the generation stays invisible to resume."""
+    import jax
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    ckpt.save_step(str(tmp_path), "step2",
+                   {"tau_raw": np.ones(12, np.float32)},
+                   np.zeros(2, np.float32), num_iters=2,
+                   converged=False, coordinate=False)
+    assert (tmp_path / "pert_step2.s1.p1of2.npz").exists()
+    assert not (tmp_path / "pert_step2.commit.json").exists()
+    assert ckpt.load_step(str(tmp_path), "step2") is None
+
+
+def test_quarantine_retires_commit_pointers(tmp_path):
+    full = np.arange(24.0, dtype=np.float32)
+    _write_generation(tmp_path, full)
+    moved = ckpt.quarantine_stale(str(tmp_path))
+    assert moved >= 3   # 2 shard files + the commit pointer
+    assert ckpt.load_step(str(tmp_path), "step2") is None
+
+
+# ---------------------------------------------------------------------------
+# multi-host manifest identity
+# ---------------------------------------------------------------------------
+
+
+def test_combined_fingerprint_dedupes_identical_hosts():
+    # the loader bridge: every host digests the same full batch, so the
+    # combined identity IS the local one — host-count-portable
+    assert manifest_mod.combined_fingerprint({0: "abc", 1: "abc"}) == "abc"
+    assert manifest_mod.combined_fingerprint({0: "abc"}) == "abc"
+    # genuinely different shards: an ordered fingerprint-of-fingerprints
+    combined = manifest_mod.combined_fingerprint({0: "abc", 1: "xyz"})
+    assert combined not in ("abc", "xyz") and len(combined) == 16
+    assert combined == manifest_mod.combined_fingerprint(
+        {1: "xyz", 0: "abc"})   # rank order, not dict order
+    assert combined != manifest_mod.combined_fingerprint(
+        {0: "xyz", 1: "abc"})
+
+
+def test_all_host_fingerprints_single_process():
+    assert manifest_mod.all_host_fingerprints("fp") == {0: "fp"}
+
+
+def test_manifest_per_host_fallback(tmp_path, monkeypatch):
+    m = manifest_mod.RunManifest.load(tmp_path)
+    m.begin_run("cfg", "combined", host_fingerprints={0: "h0", 1: "h1"})
+    m2 = manifest_mod.RunManifest.load(tmp_path)
+    # the fallback is a SAME-SHAPE instrument: this (1-process) run
+    # does not match the recorded 2-host shape, so the drifted combined
+    # digest refuses even though rank 1's shard digest matches — the
+    # missing rank's recorded data would otherwise go unverified
+    ok, _ = m2.match("cfg", "other", host_fingerprint="h1",
+                     process_index=1)
+    assert not ok
+    # same shape (2 live ranks): THIS rank's matching shard verifies
+    from scdna_replication_tools_tpu.parallel import distributed
+
+    monkeypatch.setattr(distributed, "process_rank_and_count",
+                        lambda: (1, 2))
+    ok, reason = m2.match("cfg", "other", host_fingerprint="h1",
+                          process_index=1)
+    assert ok and "per-host" in reason
+    # wrong per-host digest still refuses
+    ok, _ = m2.match("cfg", "other", host_fingerprint="nope",
+                     process_index=1)
+    assert not ok
+    # combined match needs no fallback
+    assert m2.match("cfg", "combined")[0]
+
+
+def test_manifest_records_and_clears_host_fingerprints(tmp_path):
+    m = manifest_mod.RunManifest.load(tmp_path)
+    m.begin_run("cfg", "fp", host_fingerprints={0: "a", 1: "b"})
+    assert manifest_mod.RunManifest.load(tmp_path).doc[
+        "host_fingerprints"] == {"0": "a", "1": "b"}
+    # a later single-host run retires the stale per-host map
+    m.begin_run("cfg", "fp", host_fingerprints={0: "fp"})
+    assert "host_fingerprints" not in manifest_mod.RunManifest.load(
+        tmp_path).doc
+
+
+# ---------------------------------------------------------------------------
+# elastic mesh-shrink ladder (units)
+# ---------------------------------------------------------------------------
+
+
+def test_shrink_mesh_ladder_order():
+    rungs = []
+    mesh = mesh_mod.make_mesh(4, loci_shards=2)
+    while mesh is not None:
+        mesh = mesh_mod.shrink_mesh(mesh)
+        if mesh is not None:
+            rungs.append(dict(mesh.shape))
+    # halve cells while the loci extent survives, collapse loci at the
+    # bottom, stop at the minimal 1-device mesh (1-D: make_mesh drops
+    # the loci axis at extent 1)
+    assert rungs == [{"cells": 2, "loci": 2},
+                     {"cells": 1, "loci": 2},
+                     {"cells": 1}]
+
+
+def test_shrink_mesh_minimal_is_exhausted():
+    assert mesh_mod.shrink_mesh(mesh_mod.make_mesh(1, loci_shards=1)) \
+        is None
+
+
+# ---------------------------------------------------------------------------
+# reshard resume matrix + elastic rung (integration, fast shapes)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def sharded_golden(synthetic_frames):
+    """Uninterrupted 4x2-mesh reference run."""
+    _, (s1, s2, _) = _run_pipeline(
+        synthetic_frames, PertConfig(**{**BASE, **MESH_4x2}))
+    return s1, s2
+
+
+@pytest.fixture(scope="module")
+def killed_4x2(synthetic_frames, tmp_path_factory):
+    """A 4x2 fit preempted mid-step-2, leaving a stamped checkpoint
+    directory every reshard-resume case below copies fresh."""
+    root = tmp_path_factory.mktemp("killed_4x2")
+    cfg = PertConfig(**{**BASE, **MESH_4x2,
+                        "checkpoint_dir": str(root / "ck"),
+                        "checkpoint_every": 1,
+                        "faults": "preempt@step2/chunk#2",
+                        "telemetry_path": str(root / "killed.jsonl")})
+    with pytest.raises(faults_mod.SimulatedPreemption):
+        _run_pipeline(synthetic_frames, cfg)
+    faults_mod.install(None)
+    assert list((root / "ck").glob("pert_step2*.npz"))
+    return root / "ck"
+
+
+def _resume(synthetic_frames, killed_ck, tmp_path, **mesh_kw):
+    ck = tmp_path / "ck"
+    shutil.copytree(killed_ck, ck)
+    log = tmp_path / "resumed.jsonl"
+    cfg = PertConfig(**{**BASE, **mesh_kw, "checkpoint_dir": str(ck),
+                        "checkpoint_every": 1,
+                        "telemetry_path": str(log)})
+    _, (r1, r2, _) = _run_pipeline(synthetic_frames, cfg)
+    assert validate_run(log) == []
+    return r2, _events(log)
+
+
+def test_same_mesh_resume_is_bit_exact(sharded_golden, killed_4x2,
+                                       synthetic_frames, tmp_path):
+    """4x2 -> 4x2: the reduction geometry is unchanged, so the resumed
+    trajectory must be BIT-exact against the uninterrupted golden."""
+    _, g2 = sharded_golden
+    r2, events = _resume(synthetic_frames, killed_4x2, tmp_path,
+                         **MESH_4x2)
+    np.testing.assert_array_equal(r2.fit.losses, g2.fit.losses)
+    np.testing.assert_array_equal(np.asarray(r2.fit.params["tau_raw"]),
+                                  np.asarray(g2.fit.params["tau_raw"]))
+    resumes = [ev for ev in events if ev["event"] == "resume"
+               and ev.get("action") in ("restored", "resumed")]
+    assert resumes and all(not ev["resharded"] for ev in resumes)
+
+
+def test_reshard_resume_4x2_to_single_device(sharded_golden, killed_4x2,
+                                             synthetic_frames, tmp_path):
+    """4x2 -> single device (mesh None): the checkpoint reassembles and
+    re-places on the shrunk topology; the continued trajectory is
+    parity-gated (the psum geometry changed — Adam amplifies the
+    reassociation epsilon, see test_padding_and_chunking)."""
+    _, g2 = sharded_golden
+    r2, events = _resume(synthetic_frames, killed_4x2, tmp_path,
+                         num_shards=1, loci_shards=1)
+    _assert_tau_parity(_tau(g2.fit), _tau(r2.fit))
+    # the continued loss trajectory itself stays within the measured
+    # cross-geometry envelope (reassociation epsilon through Adam)
+    np.testing.assert_allclose(np.asarray(r2.fit.losses),
+                               np.asarray(g2.fit.losses), rtol=5e-2)
+    resumes = [ev for ev in events if ev["event"] == "resume"
+               and ev.get("action") in ("restored", "resumed")]
+    assert any(ev["resharded"] for ev in resumes)
+    step2 = next(ev for ev in resumes if ev["step"] == "step2")
+    assert step2["from_topology"]["mesh_axes"] == {"cells": 4, "loci": 2}
+    assert step2["to_topology"]["mesh_axes"] == {}
+
+
+@pytest.mark.slow
+def test_reshard_resume_2x2_to_4x2(synthetic_frames, tmp_path):
+    """Growing the mesh is the same contract as shrinking it."""
+    ck = tmp_path / "ck"
+    cfg_kill = PertConfig(**{**BASE, "num_shards": 2, "loci_shards": 2,
+                             "checkpoint_dir": str(ck),
+                             "checkpoint_every": 1,
+                             "faults": "preempt@step2/chunk#2"})
+    with pytest.raises(faults_mod.SimulatedPreemption):
+        _run_pipeline(synthetic_frames, cfg_kill)
+    faults_mod.install(None)
+    log = tmp_path / "resumed.jsonl"
+    cfg = PertConfig(**{**BASE, **MESH_4x2, "checkpoint_dir": str(ck),
+                        "checkpoint_every": 1,
+                        "telemetry_path": str(log)})
+    _, (_, r2, _) = _run_pipeline(synthetic_frames, cfg)
+    events = _events(log)
+    assert any(ev["event"] == "resume" and ev.get("resharded")
+               for ev in events)
+    assert np.all(np.isfinite(np.asarray(r2.fit.losses)))
+
+
+def test_hostloss_walks_elastic_rung_to_golden(sharded_golden,
+                                               synthetic_frames,
+                                               tmp_path):
+    """A hostloss mid-sharded-fit must shrink the mesh (audited
+    ``degrade mesh_shrink`` with before/after topology), re-place the
+    last checkpoint, and still land on golden tau within parity."""
+    _, g2 = sharded_golden
+    log = tmp_path / "t.jsonl"
+    cfg = PertConfig(**{**BASE, **MESH_4x2,
+                        "checkpoint_dir": str(tmp_path / "ck"),
+                        "checkpoint_every": 1,
+                        "faults": "hostloss@step2/chunk#2",
+                        "telemetry_path": str(log)})
+    _, (_, r2, _) = _run_pipeline(synthetic_frames, cfg)
+    events = _events(log)
+    shrinks = [ev for ev in events if ev["event"] == "degrade"
+               and ev.get("action") == "mesh_shrink"]
+    assert len(shrinks) == 1
+    assert shrinks[0]["from_topology"]["mesh_axes"] == \
+        {"cells": 4, "loci": 2}
+    assert shrinks[0]["to_topology"]["mesh_axes"] == \
+        {"cells": 2, "loci": 2}
+    assert shrinks[0]["error_class"] == "hostloss"
+    assert validate_run(log) == []
+    _assert_tau_parity(_tau(g2.fit), _tau(r2.fit))
+    # the counter behind pert_mesh_shrinks_total rides the same events
+    snaps = [ev for ev in events if ev["event"] == "metrics_snapshot"]
+    if snaps:
+        assert snaps[-1]["metrics"].get(
+            "pert_mesh_shrinks_total", {}).get("value", 0) >= 1
+
+
+def test_first_oom_reenters_same_mesh_before_shrinking(synthetic_frames,
+                                                       tmp_path):
+    """Shrinking the cells axis RAISES per-device load, so a single
+    OOM must not walk the ladder: the first gets one audited same-mesh
+    re-entry (resuming the checkpoint), only the REPEAT shrinks."""
+    log = tmp_path / "t.jsonl"
+    cfg = PertConfig(**{**BASE, **MESH_4x2,
+                        "checkpoint_dir": str(tmp_path / "ck"),
+                        "checkpoint_every": 1,
+                        "faults": "oom@step2/chunk#2-3",
+                        "telemetry_path": str(log)})
+    _, (_, r2, _) = _run_pipeline(synthetic_frames, cfg)
+    events = _events(log)
+    retries = [ev for ev in events if ev["event"] == "retry"
+               and ev.get("label") == "step2/fit-oom"]
+    assert len(retries) == 1 and retries[0]["error_class"] == "oom"
+    shrinks = [ev for ev in events if ev["event"] == "degrade"
+               and ev.get("action") == "mesh_shrink"]
+    assert len(shrinks) == 1
+    assert shrinks[0]["error_class"] == "oom"
+    assert shrinks[0]["from_topology"]["mesh_axes"] == \
+        {"cells": 4, "loci": 2}
+    # the retry precedes the shrink: same-mesh first, ladder second
+    assert events.index(retries[0]) < events.index(shrinks[0])
+    assert np.all(np.isfinite(np.asarray(r2.fit.losses)))
+    assert validate_run(log) == []
+
+
+def test_elastic_rung_disabled_aborts_resumable(synthetic_frames,
+                                                tmp_path):
+    """``elastic_mesh=False``: the pre-elastic contract — abort with a
+    resumable artifact and the ``abort_resumable`` audit."""
+    log = tmp_path / "t.jsonl"
+    cfg = PertConfig(**{**BASE, **MESH_4x2, "elastic_mesh": False,
+                        "checkpoint_dir": str(tmp_path / "ck"),
+                        "checkpoint_every": 1,
+                        "faults": "hostloss@step2/chunk#2",
+                        "telemetry_path": str(log)})
+    with pytest.raises(faults_mod.SimulatedHostLoss):
+        _run_pipeline(synthetic_frames, cfg)
+    events = _events(log)
+    assert any(ev["event"] == "degrade"
+               and ev.get("action") == "abort_resumable"
+               for ev in events)
+    # the emergency save left a resumable step-2 artifact behind
+    assert list((tmp_path / "ck").glob("pert_step2*.npz"))
+
+
+def test_shrink_eligibility_units(synthetic_frames, tmp_path):
+    """The rung only accepts hostloss/OOM on a shrinkable mesh in a
+    single controlling process."""
+    s, g1, clone_idx = _dense_inputs(synthetic_frames)
+    inf = PertInference(s, g1, PertConfig(**{**BASE, **MESH_4x2}),
+                        clone_idx_s=clone_idx, clone_idx_g1=clone_idx,
+                        num_clones=2)
+    assert inf._shrink_eligible("hostloss")
+    assert inf._shrink_eligible("oom")
+    assert not inf._shrink_eligible("hang")
+    assert not inf._shrink_eligible("preemption")
+    inf._mesh = None          # single device: nothing to shrink
+    assert not inf._shrink_eligible("hostloss")

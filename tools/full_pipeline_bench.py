@@ -142,7 +142,8 @@ def run(args):
                 metrics_textfile=args.metrics_textfile,
                 checkpoint_dir=args.checkpoint_dir, resume=args.resume,
                 watchdog_compile_seconds=args.watchdog_compile,
-                watchdog_chunk_seconds=args.watchdog_chunk)
+                watchdog_chunk_seconds=args.watchdog_chunk,
+                elastic_mesh=args.elastic_mesh)
     if args.profile_dir:
         import dataclasses
         scrt.config = dataclasses.replace(scrt.config,
@@ -318,6 +319,12 @@ def main(argv=None):
     ap.add_argument("--watchdog-chunk", type=float, default=None,
                     help="fit-chunk deadline in seconds "
                          "(PertConfig.watchdog_chunk_seconds)")
+    ap.add_argument("--elastic-mesh",
+                    action=argparse.BooleanOptionalAction, default=True,
+                    help="elastic mesh-shrink recovery rung: on "
+                         "host/device loss or OOM in a sharded fit, "
+                         "rebuild a smaller mesh and continue from the "
+                         "last checkpoint (PertConfig.elastic_mesh)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--profile-dir", default=None)
     ap.add_argument("--out", default=None)

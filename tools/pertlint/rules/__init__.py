@@ -10,6 +10,7 @@ from tools.pertlint.rules import (  # noqa: F401
     metric_names,
     partition_spec,
     print_log,
+    raw_writes,
     rng,
     swallowed,
     tracer_branch,

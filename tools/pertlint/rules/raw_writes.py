@@ -1,0 +1,118 @@
+"""PL013: raw checkpoint-like writes bypassing the atomic-commit
+primitive.
+
+Every durable artifact in the package — checkpoints, the run manifest,
+the sharded-generation commit pointer, the Prometheus textfile — goes
+through ``utils/fileio.py::atomic_write_bytes`` (mkstemp + write +
+fsync + ``os.replace``), because the durability contract (OBSERVABILITY
+"Durable runs") promises a preemption mid-write can never leave a torn
+file visible to ``--resume auto``.  A direct ``np.savez(path, ...)`` or
+``open(path, 'wb')`` re-introduces exactly the crash window the
+two-phase commit exists to close: the file exists, half-written, with
+no integrity footer committed — and the NEXT run trusts it.
+
+Precision contract (what keeps this rule quiet on correct code):
+
+* ``np.savez``/``np.savez_compressed``/``np.save`` fire only when the
+  first argument is not an obvious in-memory buffer: a name containing
+  ``buf``/``bio``/``stream``, or a direct ``io.BytesIO(...)`` call, is
+  the sanctioned serialise-to-memory idiom (the caller then commits the
+  bytes atomically, footer included);
+* ``open(..., mode)`` fires only for BINARY WRITE modes (a ``b`` plus
+  any of ``w``/``x``/``a`` in a literal mode string) — text-mode writes
+  (reports, markdown) are not durability-bearing artifacts, and read
+  modes never match; a non-literal mode cannot be judged and is exempt;
+* only the builtin ``open`` NAME fires (``os.fdopen`` inside the
+  primitive itself, ``gzip.open`` readers etc. are attribute calls or
+  different names);
+* ``utils/fileio.py`` is exempt by path — it IS the primitive;
+* a deliberate raw write stays expressible with the inline suppression
+  (``# pertlint: disable=PL013``) carrying its why, or a baseline
+  entry with a rationale.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.pertlint.core import Finding, Rule, register
+
+_NP_WRITERS = {"savez", "savez_compressed", "save"}
+_BUFFERISH = ("buf", "bio", "stream")
+
+
+def _is_buffer_arg(arg: ast.expr) -> bool:
+    """Does the first np.savez argument look like an in-memory buffer?"""
+    if isinstance(arg, ast.Name):
+        return any(tok in arg.id.lower() for tok in _BUFFERISH)
+    if isinstance(arg, ast.Call):
+        func = arg.func
+        if isinstance(func, ast.Name) and func.id == "BytesIO":
+            return True
+        if isinstance(func, ast.Attribute) and func.attr == "BytesIO":
+            return True
+    return False
+
+
+def _binary_write_mode(call: ast.Call):
+    """The literal mode string when this ``open`` call writes binary,
+    else None (read modes, text modes, non-literal modes)."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    else:
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+    if not isinstance(mode, ast.Constant) or not isinstance(mode.value,
+                                                            str):
+        return None
+    value = mode.value
+    if "b" in value and any(m in value for m in ("w", "x", "a")):
+        return value
+    return None
+
+
+@register
+class RawDurableWrite(Rule):
+    id = "PL013"
+    name = "raw-checkpoint-write"
+    severity = "error"
+    description = ("direct np.savez/open(..., 'wb') write that bypasses "
+                   "utils/fileio.atomic_write_bytes — a crash mid-write "
+                   "leaves a torn artifact visible to --resume auto; "
+                   "serialise to memory and commit atomically")
+
+    def check(self, ctx) -> Iterable[Finding]:
+        path = str(ctx.path).replace("\\", "/")
+        if path.endswith("utils/fileio.py"):
+            return   # the primitive's own fd plumbing lives here
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute) \
+                    and func.attr in _NP_WRITERS \
+                    and isinstance(func.value, ast.Name) \
+                    and func.value.id in ("np", "numpy"):
+                if node.args and _is_buffer_arg(node.args[0]):
+                    continue
+                yield self.finding(
+                    ctx, node,
+                    f"np.{func.attr}(...) writes a durable artifact "
+                    f"directly to its path — a crash mid-write leaves a "
+                    f"torn, footerless file the next --resume auto "
+                    f"trusts; serialise to an in-memory buffer and "
+                    f"commit through utils/fileio.atomic_write_bytes")
+            elif isinstance(func, ast.Name) and func.id == "open":
+                mode = _binary_write_mode(node)
+                if mode is None:
+                    continue
+                yield self.finding(
+                    ctx, node,
+                    f"open(..., {mode!r}) writes binary bytes in place "
+                    f"— a checkpoint-like artifact must go through "
+                    f"utils/fileio.atomic_write_bytes (mkstemp + fsync "
+                    f"+ os.replace) so a preemption mid-write can never "
+                    f"leave a torn file visible to --resume auto")

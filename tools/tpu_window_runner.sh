@@ -80,7 +80,13 @@ run_one() {  # run_one <name> <tpu_field> <timeout_s> <cmd...>
 # mid-budget instead of restarting the whole stage (and the per-stage
 # `timeout` plus the in-process watchdogs convert hangs into typed,
 # resumable aborts instead of rc=124 with nothing written).
-DURABLE="--resume auto --watchdog-compile 600 --watchdog-chunk 600"
+# Topology-portable (checkpoint format v4): the stamp in every save
+# lets a stage checkpointed on one window's mesh shape resume on
+# whatever shape the NEXT window offers (fewer chips, or none —
+# single-device), and --elastic-mesh keeps a stage alive in-window
+# when a device drops out of the slice: the mesh shrinks (audited as
+# `degrade mesh_shrink`) instead of the stage aborting.
+DURABLE="--resume auto --watchdog-compile 600 --watchdog-chunk 600 --elastic-mesh"
 
 battery() {  # returns 0 only if every step it attempted succeeded
     # --budget full: keep the production-shaped sizes on TPU (bench.py
