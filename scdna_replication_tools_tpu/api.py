@@ -75,6 +75,11 @@ class scRT:
     controller chunks), ``faults`` (deterministic fault-injection spec,
     chaos-testing only) and ``watchdog_compile_seconds`` /
     ``watchdog_chunk_seconds`` (per-phase hang deadlines);
+    ``pad_cells_to``/``pad_loci_to`` (shape-bucket padding: runs padded
+    to the same targets compile the same XLA programs — the resident
+    serving worker's cache contract, see README "Serving") and
+    ``request_id`` (per-request identity stamped into the run log,
+    excluded from the config hash);
     ``telemetry_path`` (structured JSONL run log, 'auto' = repo-local
     ``.pert_runs/``; the written path is surfaced as
     ``scRT.run_log_path`` — see OBSERVABILITY.md);
@@ -119,6 +124,7 @@ class scRT:
                  resume='auto', checkpoint_every=4, faults=None,
                  watchdog_compile_seconds=None,
                  watchdog_chunk_seconds=None, elastic_mesh=True,
+                 pad_cells_to=None, pad_loci_to=None, request_id=None,
                  enum_impl='auto', fused_adam='auto',
                  optimizer_state_dtype='float32', cn_hmm_self_prob=None,
                  rho_from_rt_prior=False, mirror_rescue=True,
@@ -161,6 +167,8 @@ class scRT:
             watchdog_compile_seconds=watchdog_compile_seconds,
             watchdog_chunk_seconds=watchdog_chunk_seconds,
             elastic_mesh=elastic_mesh,
+            pad_cells_to=pad_cells_to, pad_loci_to=pad_loci_to,
+            request_id=request_id,
             enum_impl=enum_impl, fused_adam=fused_adam,
             optimizer_state_dtype=optimizer_state_dtype,
             cn_hmm_self_prob=cn_hmm_self_prob,
@@ -262,10 +270,18 @@ class scRT:
             registry = metrics_mod.MetricsRegistry.create(
                 textfile_path=self.config.metrics_textfile)
             metrics_mod.install(registry)
-            metrics_mod.attach_phase_sink(timer)
+            # pinned to THIS run's registry (not call-time resolution
+            # of the process-global seam): a serving worker interleaves
+            # its own log/registry with per-request runs, and phase
+            # seconds must never cross-feed between them
+            metrics_mod.attach_phase_sink(timer, registry=registry)
             self.metrics_registry = registry
             run_log = RunLog.create(self.config.telemetry_path)
         run_log.metrics_registry = registry
+        if self.config.request_id:
+            # per-request identity for the fleet index (`--request`);
+            # folded into run_start by the pending-context path
+            run_log.add_context(request_id=str(self.config.request_id))
         self.run_log_path = run_log.path
         with run_log.session(config=self.config, timer=timer):
             with timer.phase("clone_prep"):

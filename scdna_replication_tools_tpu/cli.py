@@ -95,6 +95,24 @@ def infer_scrt_main(argv=None):
                         "continue from the last checkpoint instead of "
                         "aborting (PertConfig.elastic_mesh; each shrink "
                         "is audited as a 'degrade mesh_shrink' event)")
+    p.add_argument("--pad-cells-to", type=int, default=None,
+                   help="pad the cells axes (S and G1) up to at least "
+                        "this many entries with masked pad cells — the "
+                        "shape-bucket contract: runs padded to the same "
+                        "targets compile the same XLA programs, so a "
+                        "resident worker (pert-serve) serves them from "
+                        "its program cache (PertConfig.pad_cells_to)")
+    p.add_argument("--pad-loci-to", type=int, default=None,
+                   help="pad the loci axis up to at least this many "
+                        "bins with masked pad loci (the other half of "
+                        "the shape-bucket contract; "
+                        "PertConfig.pad_loci_to)")
+    p.add_argument("--request-id", default=None,
+                   help="opaque per-request identity stamped into the "
+                        "run log's run_start (serving traffic: "
+                        "pert_fleet query/trend --request groups on "
+                        "it); excluded from the config hash "
+                        "(PertConfig.request_id)")
     p.add_argument("--mirror-rescue", action=BooleanOptionalAction,
                    default=True,
                    help="post-step-2 mirror-basin rescue for boundary-tau "
@@ -169,6 +187,9 @@ def infer_scrt_main(argv=None):
                 checkpoint_every=args.checkpoint_every,
                 faults=args.faults,
                 elastic_mesh=args.elastic_mesh,
+                pad_cells_to=args.pad_cells_to,
+                pad_loci_to=args.pad_loci_to,
+                request_id=args.request_id,
                 mirror_rescue=args.mirror_rescue,
                 compile_cache_dir=args.compile_cache,
                 telemetry_path=args.telemetry,

@@ -97,6 +97,28 @@ class PertConfig:
     # total devices = num_shards * loci_shards).  For the long-genome
     # regime (20kb bins); loci are padded + masked to shard evenly.
     loci_shards: int = 1
+    # --- shape-bucket padding (the serving worker's program-residency
+    # contract; see serve/buckets.py and OBSERVABILITY.md "Serving") ---
+    # pad the cells axis (both the S and G1 populations) / the loci
+    # axis up to AT LEAST this many entries with masked pad rows, on
+    # top of the shard-multiple padding.  Two runs padded to the same
+    # targets (same P/K/library count) trace and compile the SAME XLA
+    # programs, so a long-lived worker serves every request in a shape
+    # bucket from its resident AOT program cache — compile amortises
+    # to zero across the bucket.  None (default) keeps the exact-shape
+    # behaviour.  Must be a multiple of the shard count when a mesh is
+    # active (the bucket ladder's powers of two satisfy any power-of-
+    # two mesh).
+    pad_cells_to: Optional[int] = None
+    pad_loci_to: Optional[int] = None
+    # opaque per-request identity stamped into the run log's run_start
+    # (serving worker: one scRT run per queued request).  EXCLUDED from
+    # the config hash like telemetry_path: the hash is a workload
+    # identity, and a unique id per request would make every request
+    # hash distinct even inside one bucket.  The fleet index groups
+    # serve traffic by this id instead (`pert_fleet query/trend
+    # --request`).  No behavioural effect.
+    request_id: Optional[str] = None
     # write checkpoints at step boundaries (step1/step2/step3) to this dir.
     checkpoint_dir: Optional[str] = None
     # --- durable runs (see OBSERVABILITY.md "Durable runs & resume") ---

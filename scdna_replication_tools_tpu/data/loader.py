@@ -368,15 +368,22 @@ def attach_dense_columns(
     return out
 
 
-def pad_cells(data: PertData, multiple: int) -> PertData:
+def pad_cells(data: PertData, multiple: int = 1,
+              minimum: Optional[int] = None) -> PertData:
     """Pad the cells axis to a multiple of ``multiple`` with masked cells.
 
     Padding keeps shapes static for XLA and lets the cells axis shard
     evenly over a device mesh; padded cells carry ``cell_mask=False`` and
     contribute zero to every masked reduction in the compiled loss.
+
+    ``minimum`` raises the target to at least that many cells (still
+    rounded up to ``multiple``) — the shape-bucket contract
+    (``PertConfig.pad_cells_to``): every request padded to the same
+    bucket dims shares one compiled program in a resident worker.
     """
     n = data.num_cells
-    target = ((n + multiple - 1) // multiple) * multiple
+    target = max(n, int(minimum or 0))
+    target = ((target + multiple - 1) // multiple) * multiple
     if target == n:
         return data
     pad = target - n
@@ -396,7 +403,8 @@ def pad_cells(data: PertData, multiple: int) -> PertData:
     )
 
 
-def pad_loci(data: PertData, multiple: int) -> PertData:
+def pad_loci(data: PertData, multiple: int = 1,
+             minimum: Optional[int] = None) -> PertData:
     """Pad the loci axis to a multiple of ``multiple`` with masked loci.
 
     The loci analog of :func:`pad_cells`, for sharding the loci axis of a
@@ -405,10 +413,13 @@ def pad_loci(data: PertData, multiple: int) -> PertData:
     padded bins are masked out of every reduction instead).  Padded loci
     get chr='__PAD__' index entries (dropped by the inner merge when
     results are melted back to long form), neutral GC (0.45) and
-    mid-range RT prior (0.5).
+    mid-range RT prior (0.5).  ``minimum`` raises the target to at
+    least that many loci (``PertConfig.pad_loci_to`` — the shape-bucket
+    contract, see :func:`pad_cells`).
     """
     n = data.num_loci
-    target = ((n + multiple - 1) // multiple) * multiple
+    target = max(n, int(minimum or 0))
+    target = ((target + multiple - 1) // multiple) * multiple
     if target == n:
         return data
     pad = target - n

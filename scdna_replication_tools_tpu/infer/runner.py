@@ -202,9 +202,20 @@ class PertInference:
             else metrics_mod.MetricsRegistry.create(
                 textfile_path=config.metrics_textfile)
         metrics_mod.install(self.metrics)
-        metrics_mod.attach_phase_sink(self.phases)
-        # the log's final run_end snapshot comes from THIS registry
+        # the phase sink is pinned to THIS registry (not resolved from
+        # the process-global seam at call time): a worker interleaving
+        # a worker-level log with per-request runs must never cross-
+        # feed phase seconds between their registries
+        metrics_mod.attach_phase_sink(self.phases, registry=self.metrics)
+        # the log's final run_end snapshot comes from THIS registry —
+        # and the emit seam routes every event this log records into it
         self.run_log.metrics_registry = self.metrics
+        if config.request_id and run_log is None:
+            # serving-worker identity: folded into run_start so the
+            # fleet index can group per-request logs (`--request`).
+            # Directly-driven runners only — the api facade stamps the
+            # log it owns itself, before its session opens
+            self.run_log.add_context(request_id=str(config.request_id))
         # persistent XLA compilation cache (no-op when already configured
         # or disabled): repeated runs skip the per-step-program compiles
         self.compile_cache_dir = profiling.enable_persistent_compile_cache(
@@ -482,10 +493,20 @@ class PertInference:
                 "cell_chunk is a single-device memory knob; use sharding "
                 "for multi-device runs")
             mult *= self.config.cell_chunk
-        if mult > 1:
-            data = pad_cells(data, mult)
-        if loci_mult > 1:
-            data = pad_loci(data, loci_mult)
+        # shape-bucket targets (PertConfig.pad_cells_to/pad_loci_to):
+        # pad up to the bucket dims ON TOP of the shard-multiple
+        # padding, so every request the serving worker admits into one
+        # bucket produces identically-shaped batches — and therefore
+        # hits the resident AOT program cache instead of compiling.
+        # A population larger than its target simply pads to the
+        # multiple as before (the worker's bucket selection refuses
+        # oversized requests before they reach the runner).
+        cells_min = self.config.pad_cells_to
+        loci_min = self.config.pad_loci_to
+        if mult > 1 or cells_min:
+            data = pad_cells(data, mult, minimum=cells_min)
+        if loci_mult > 1 or loci_min:
+            data = pad_loci(data, loci_mult, minimum=loci_min)
         return data
 
     def g1_g2_doubled_batch(self) -> Tuple[PertBatch, PertData]:
@@ -899,8 +920,11 @@ class PertInference:
         # classic kill-between-steps window
         faults_mod.point(f"{step_name}/start")
         # HBM high-water before the step's programs run, so the
-        # per-phase delta in the snapshots is attributable to the step
-        metrics_mod.current().sample_device_memory()
+        # per-phase delta in the snapshots is attributable to the step.
+        # self.metrics, not the process-global seam: this run's samples
+        # must land in this run's registry even when another run is
+        # interleaved in the same process (the serving worker)
+        self.metrics.sample_device_memory()
         if self._manifest is not None:
             self._manifest.update_step(
                 step_name, "in_flight",
@@ -952,7 +976,7 @@ class PertInference:
                 enum_impl_binary,
                 planes_per_iter,
             )
-            metrics_mod.current().gauge(
+            self.metrics.gauge(
                 "pert_planes_moved_per_iter",
                 labels={"step": step_name}).set(planes_per_iter(
                     spec.P, binary=enum_impl_binary(spec.enum_impl),
@@ -997,6 +1021,14 @@ class PertInference:
                         num_iters=int(num_iters), checkpoint=path,
                         exact=bool(exact))
 
+        # injection site at the fit dispatch itself ({step}/fit):
+        # distinct from {step}/chunk (inside the chunked driver's host
+        # loop) so a chaos spec can fail a WHOLE step fit on its first
+        # attempt — the serve suite's per-request isolation case
+        # (`oom@step2/fit#1` on one queued request) fires here, walks
+        # the normal abort-resumable audit, and must take down only
+        # that request, never the worker
+        faults_mod.point(f"{step_name}/fit")
         t0 = time.perf_counter()
         with profiling.trace(cfg.profile_dir):
             fit = fit_map(loss_fn, params0, (fixed, batch),
@@ -1065,8 +1097,7 @@ class PertInference:
         # as its own phase — the >=95%-coverage invariant must absorb
         # the export cost, however small
         with self.phases.phase(f"{step_name}/metrics"):
-            metrics_mod.current().emit_snapshot(self.run_log,
-                                                f"{step_name}/end")
+            self.metrics.emit_snapshot(self.run_log, f"{step_name}/end")
         return StepOutput(fit, spec, fixed, batch, wall)
 
     @staticmethod

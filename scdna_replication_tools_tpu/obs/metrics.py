@@ -392,6 +392,25 @@ class MetricsRegistry:
                 int(payload.get("accepted") or 0))
         elif event == "nan_abort":
             self.counter("pert_nan_aborts_total").inc()
+        elif event == "request_start":
+            # serving-worker request admission (schema v7): the queue
+            # depth observed at admission and the bucket's padding
+            # overhead ride the emit seam like every other event-fed
+            # metric, so the worker's scrape surface needs no direct
+            # registry plumbing at the emit sites
+            if payload.get("queue_depth") is not None:
+                self.gauge("pert_serve_queue_depth").set(
+                    int(payload["queue_depth"]))
+            if payload.get("pad_frac") is not None \
+                    and payload.get("bucket"):
+                self.gauge("pert_serve_bucket_pad_frac",
+                           labels={"bucket":
+                                   str(payload["bucket"].get("name"))}
+                           ).set(round(float(payload["pad_frac"]), 6))
+        elif event == "request_end":
+            self.counter("pert_serve_requests_total",
+                         labels={"status": str(payload.get("status"))}
+                         ).inc()
 
     def sample_device_memory(self) -> None:
         """Per-device HBM gauges from ``memory_stats()``; graceful no-op
@@ -604,22 +623,42 @@ def current():
     return _ACTIVE if _ACTIVE is not None else _NULL
 
 
-def attach_phase_sink(timer) -> None:
-    """Chain a metrics sink onto ``timer.on_add`` (PhaseTimer).
+def attach_phase_sink(timer, registry: Optional[MetricsRegistry] = None
+                      ) -> None:
+    """Attach (or re-scope) THE metrics sink of a PhaseTimer.
 
-    The sink resolves :func:`current` at call time (so it can be
-    attached before any registry exists) and forwards to whatever sink
-    was already installed — co-existing with the RunLog's session sink
-    regardless of attach order.  Idempotent per timer.
+    ``registry`` pins the sink to ONE registry — the log-scoped
+    routing the serving worker relies on: a per-request timer feeds the
+    request's registry no matter what the process-global seam points at
+    when the phase closes.  Without it the sink resolves
+    :func:`current` at call time (so it can be attached before any
+    registry exists).  The sink forwards to whatever ``on_add`` was
+    already installed — co-existing with the RunLog's session sink
+    regardless of attach order.
+
+    ONE metrics sink per timer, wherever it sits in the chain: the
+    sink reads its registry from a mutable cell, and a re-attach
+    (same or different registry) re-scopes that cell IN PLACE instead
+    of stacking a second sink.  Stacking would double-feed two
+    registries — the exact cross-feed this scoping exists to prevent
+    — and an outermost-only replacement would miss a metrics sink a
+    RunLog session has since chained over (the session's own sink
+    wraps whatever was installed when it opened).
     """
-    prev = getattr(timer, "on_add", None)
-    if getattr(prev, "_pert_metrics_sink", False):
+    existing = getattr(timer, "_pert_metrics_sink_fn", None)
+    if existing is not None:
+        existing._pert_registry_cell[0] = registry
         return
+    prev = getattr(timer, "on_add", None)
+    cell = [registry]
 
     def _sink(name, seconds):
-        current().observe_phase(name, seconds)
+        reg = cell[0] if cell[0] is not None else current()
+        reg.observe_phase(name, seconds)
         if prev is not None:
             prev(name, seconds)
 
     _sink._pert_metrics_sink = True
+    _sink._pert_registry_cell = cell
+    timer._pert_metrics_sink_fn = _sink
     timer.on_add = _sink

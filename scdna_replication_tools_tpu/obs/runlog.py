@@ -38,15 +38,20 @@ from scdna_replication_tools_tpu.obs import metrics as _metrics
 from scdna_replication_tools_tpu.utils import profiling
 from scdna_replication_tools_tpu.utils.profiling import logger
 
-SCHEMA_VERSION = 6  # v6: topology-portable durable runs — `hostloss`
-# fault kind + per-rule process scope, `degrade mesh_shrink` (the
-# elastic recovery rung, with before/after topology) and the resume
-# event's reshard trail (resharded + from/to topology); v5
-# metrics_snapshot (the typed metrics registry's phase-boundary
-# export, obs/metrics.py); v4 added durability events (fault_injected,
-# retry, degrade, resume — the fault-tolerance layer's audit trail);
-# v3 control_decision (adaptive fit controller); v2 the model-health
-# events (fit_health, cell_qc_summary)
+SCHEMA_VERSION = 7  # v7: the serving worker's request lifecycle —
+# `request_start`/`request_end` events (tools/pert_serve.py worker,
+# serve/worker.py) plus the optional `request_id` field on run_start
+# (per-request RunLogs written under the worker's results tree carry
+# it, so the fleet index can group serve traffic by request); v6
+# topology-portable durable runs — `hostloss` fault kind + per-rule
+# process scope, `degrade mesh_shrink` (the elastic recovery rung,
+# with before/after topology) and the resume event's reshard trail
+# (resharded + from/to topology); v5 metrics_snapshot (the typed
+# metrics registry's phase-boundary export, obs/metrics.py); v4 added
+# durability events (fault_injected, retry, degrade, resume — the
+# fault-tolerance layer's audit trail); v3 control_decision (adaptive
+# fit controller); v2 the model-health events (fit_health,
+# cell_qc_summary)
 
 
 def _json_safe(value):
@@ -140,16 +145,21 @@ def _config_digest(config) -> Optional[str]:
     ``telemetry_path`` and ``metrics_textfile`` are excluded: they name
     where THIS run's observability lands (every run's differs), and the
     hash's job is "same experiment?" — a cold/warm or A/B pair must
-    hash equal when only the log/scrape locations moved.  Fields that
-    change behaviour (compile_cache_dir, checkpoint_dir, iteration
-    budgets, ...) stay in.
+    hash equal when only the log/scrape locations moved.
+    ``request_id`` is excluded for the same reason in serving terms:
+    it is pure per-request identity (the fleet index groups serve
+    traffic by it separately, via ``--request``) and folding it in
+    would make every request hash distinct by construction.  Fields
+    that change behaviour (compile_cache_dir, checkpoint_dir,
+    iteration budgets, ...) stay in.
     """
     try:
         if dataclasses.is_dataclass(config):
             config = dataclasses.asdict(config)
         if isinstance(config, dict):
             config = {k: v for k, v in config.items()
-                      if k not in ("telemetry_path", "metrics_textfile")}
+                      if k not in ("telemetry_path", "metrics_textfile",
+                                   "request_id")}
         blob = json.dumps(config, sort_keys=True, default=_json_safe)
         return hashlib.sha256(blob.encode()).hexdigest()[:12]
     except (TypeError, ValueError):
@@ -432,10 +442,18 @@ class RunLog:
         artifact (``run_end`` itself is written before ``_open``
         clears)."""
         # the metrics seam: every emit — BEFORE the enable/session
-        # gating — feeds the active registry, so counters (fit iters,
-        # cache hits, degrades, faults...) accumulate even when the
-        # JSONL itself is disabled or the event would be dropped
-        _metrics.current().record_event(event, payload)
+        # gating — feeds a registry, so counters (fit iters, cache
+        # hits, degrades, faults...) accumulate even when the JSONL
+        # itself is disabled or the event would be dropped.  Resolution
+        # is LOG-SCOPED: a log that owns a registry feeds THAT one, so
+        # two interleaved runs in one process (a serving worker's
+        # worker-level log plus a per-request log) can never cross-feed
+        # each other's gauges; only registry-less logs fall back to the
+        # process-global seam (bare logs in tests, layers emitting
+        # through :func:`current`).
+        registry = self.metrics_registry if self.metrics_registry \
+            is not None else _metrics.current()
+        registry.record_event(event, payload)
         if not self.enabled or not self._open:
             return
         record = {"event": event, "seq": self._seq,

@@ -193,6 +193,11 @@ def summarize_events(events: List[dict]) -> dict:
 
     return {
         "run_name": start.get("run_name"),
+        # serve traffic (schema v7): per-request RunLogs carry the
+        # request id in run_start; a worker-level log instead carries
+        # the request lifecycle events below.  Both None/empty on
+        # non-serve logs.
+        "request_id": start.get("request_id"),
         "schema_version": start.get("schema_version"),
         "started_unix": start.get("started_unix"),
         "config_hash": start.get("config_hash"),
@@ -241,6 +246,14 @@ def summarize_events(events: List[dict]) -> dict:
             "final": (snaps[-1].get("metrics") or None) if snaps else None,
             "hbm_by_phase": hbm_by_phase,
         },
+        "requests": [{
+            "request_id": ev.get("request_id"),
+            "status": ev.get("status"),
+            "wall_seconds": ev.get("wall_seconds"),
+            "bucket": ev.get("bucket"),
+            "compile_cache": ev.get("compile_cache"),
+            "error_class": ev.get("error_class"),
+        } for ev in _of(events, "request_end")],
         "rescues": _of(events, "rescue"),
         "nan_aborts": _of(events, "nan_abort"),
         "checkpoints": _of(events, "checkpoint"),

@@ -1,0 +1,48 @@
+"""pertserve: a persistent, shape-bucketed, batched inference service.
+
+Every PERT run today is a cold CLI process that pays import + trace +
+compile before touching data.  The north star (ROADMAP item 2) is
+serving heavy traffic from millions of users, where that cold-start
+cost dominates: accelerators pay off only when batched work keeps them
+full ("Efficiently Vectorized MCMC on Modern Accelerators",
+arXiv 2503.17405), and NumPyro's composable-effects design
+(arXiv 1912.11554) is what makes the fit a pure compiled function that
+is safe to reuse across tenants.  This package supplies the missing
+long-lived worker:
+
+* :mod:`~scdna_replication_tools_tpu.serve.buckets` — the shape-bucket
+  ladder: every admitted request is padded into the nearest of a small
+  set of (cells, loci) buckets (``PertConfig.pad_cells_to`` /
+  ``pad_loci_to``), so one compiled program serves every request in a
+  bucket and compile cost amortises to zero across the bucket;
+* :mod:`~scdna_replication_tools_tpu.serve.queue` — a file-queue spool
+  directory (atomic ticket submission, rename-based claiming, a
+  per-request results tree).  Simple, testable, CI-able; no network
+  dependency — a network front-end can feed the same spool later;
+* :mod:`~scdna_replication_tools_tpu.serve.worker` — the worker
+  daemon: admits requests, runs each as one :class:`api.scRT` pipeline
+  with per-request RunLog + metrics registry + checkpoint dir (fault
+  isolation: an OOM or NaN escalation in one request degrades/aborts
+  that request's manifest via the durable-run ladder, never the
+  worker), streams results + ``cell_qc`` back per request, and drains
+  gracefully on a shutdown signal.
+
+CLI: ``pert-serve`` (tools/pert_serve.py) — ``worker`` / ``submit`` /
+``status`` / ``collect``.  Bench: ``bench.py --serve-ab`` measures the
+warm worker against N cold CLI runs.  See README "Serving" and
+OBSERVABILITY.md for the v7 ``request_start``/``request_end`` events
+and the worker gauges.
+"""
+
+from scdna_replication_tools_tpu.serve.buckets import (  # noqa: F401
+    Bucket,
+    BucketRefusal,
+    BucketSet,
+)
+from scdna_replication_tools_tpu.serve.queue import (  # noqa: F401
+    RequestTicket,
+    SpoolQueue,
+)
+from scdna_replication_tools_tpu.serve.worker import (  # noqa: F401
+    ServeWorker,
+)

@@ -1,0 +1,243 @@
+"""File-queue spool: the serving worker's request transport.
+
+A request is one JSON ticket in a spool directory — simple, testable,
+CI-able, no network dependency (a network front-end can feed the same
+spool later; the worker neither knows nor cares).  The protocol leans
+entirely on two POSIX atomicity guarantees:
+
+* **submission** writes the ticket with
+  ``utils.fileio.atomic_write_bytes`` (same-directory temp + fsync +
+  ``os.replace``), so the worker can never observe a torn ticket;
+* **claiming** is ``os.rename(pending/x.json, active/x.json)`` — a
+  rename either succeeds (this worker owns the request) or raises
+  (another worker won the race); no locks, no leases.
+
+Layout under the spool root::
+
+    pending/<request_id>.json   submitted, waiting
+    active/<request_id>.json    claimed by a worker
+    done/<request_id>.json      terminal ticket (status + result paths)
+    failed/<request_id>.json    terminal ticket (status + error)
+    data/<request_id>/          input TSVs (``submit_frames`` writes
+                                them here; ``submit`` may reference
+                                files anywhere)
+    results/<request_id>/       the worker's per-request output tree:
+                                output.tsv, supp.tsv, cell_qc.tsv,
+                                run.jsonl (the request's RunLog),
+                                ckpt/ (per-request durable-run
+                                checkpoints), request.json (the final
+                                ticket, duplicated for collectors that
+                                only see the results tree)
+
+Tickets are ordered FIFO by submission time (ticket mtime, request id
+as the same-instant tiebreak — caller-supplied ids must not jump the
+queue).  ``options`` is the whitelisted subset of ``scRT``
+keyword arguments a request may override (budgets, prior method,
+faults for chaos suites, ...) — the worker merges them over its own
+defaults; see ``serve/worker.py::REQUEST_OPTION_KEYS``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import os
+import pathlib
+import time
+from typing import List, Optional
+
+from scdna_replication_tools_tpu.utils.fileio import atomic_write_bytes
+
+_STATES = ("pending", "active", "done", "failed")
+_TICKET_COUNTER = itertools.count()
+
+
+def _new_request_id() -> str:
+    """Time-sortable unique id: second stamp + pid + per-process
+    counter — FIFO order IS lexical order, and two processes (or two
+    same-second submissions of one process) cannot collide."""
+    return (f"req_{time.strftime('%Y%m%d_%H%M%S')}_{os.getpid()}"
+            f"_{next(_TICKET_COUNTER):06d}")
+
+
+@dataclasses.dataclass
+class RequestTicket:
+    """One queued inference request (the JSON ticket's typed view)."""
+
+    request_id: str
+    s_path: str
+    g1_path: str
+    options: dict = dataclasses.field(default_factory=dict)
+    submitted_unix: float = 0.0
+    # terminal fields, filled by the worker's finish()
+    status: Optional[str] = None          # ok / failed / refused
+    error: Optional[str] = None
+    results_dir: Optional[str] = None
+
+    def to_json(self) -> bytes:
+        return (json.dumps(dataclasses.asdict(self), indent=1,
+                           sort_keys=True) + "\n").encode()
+
+    @classmethod
+    def from_json(cls, blob: bytes) -> "RequestTicket":
+        doc = json.loads(blob)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in doc.items() if k in known})
+
+
+class SpoolQueue:
+    """One spool directory (see module docstring)."""
+
+    def __init__(self, root):
+        self.root = pathlib.Path(root)
+
+    def ensure_dirs(self) -> None:
+        for state in _STATES:
+            (self.root / state).mkdir(parents=True, exist_ok=True)
+        (self.root / "results").mkdir(parents=True, exist_ok=True)
+
+    def _ticket_path(self, state: str, request_id: str) -> pathlib.Path:
+        return self.root / state / f"{request_id}.json"
+
+    def results_dir(self, request_id: str) -> pathlib.Path:
+        return self.root / "results" / request_id
+
+    # -- submission -------------------------------------------------------
+
+    def submit(self, s_path, g1_path, options: Optional[dict] = None,
+               request_id: Optional[str] = None) -> str:
+        """Queue a request referencing existing input TSVs; returns the
+        request id.  Submission is atomic: the worker either sees the
+        whole ticket in ``pending/`` or nothing."""
+        self.ensure_dirs()
+        request_id = request_id or _new_request_id()
+        if any(self._ticket_path(s, request_id).exists()
+               for s in _STATES):
+            raise ValueError(f"request id {request_id!r} already exists "
+                             f"in the spool {self.root}")
+        ticket = RequestTicket(
+            request_id=request_id, s_path=str(s_path),
+            g1_path=str(g1_path), options=dict(options or {}),
+            submitted_unix=round(time.time(), 3))
+        atomic_write_bytes(self._ticket_path("pending", request_id),
+                           ticket.to_json())
+        return request_id
+
+    def submit_frames(self, df_s, df_g1, options: Optional[dict] = None,
+                      request_id: Optional[str] = None) -> str:
+        """Queue a request from in-memory long-form frames: the frames
+        land as TSVs under ``data/<id>/`` BEFORE the ticket appears in
+        ``pending/`` (the ticket's atomic rename is the commit point,
+        so a worker can never claim a request whose data is still
+        being written)."""
+        request_id = request_id or _new_request_id()
+        data_dir = self.root / "data" / request_id
+        data_dir.mkdir(parents=True, exist_ok=True)
+        s_path = data_dir / "cn_s.tsv"
+        g1_path = data_dir / "cn_g1.tsv"
+        df_s.to_csv(s_path, sep="\t", index=False)
+        df_g1.to_csv(g1_path, sep="\t", index=False)
+        return self.submit(s_path, g1_path, options=options,
+                           request_id=request_id)
+
+    # -- worker side ------------------------------------------------------
+
+    def pending(self) -> List[pathlib.Path]:
+        """Pending ticket paths in FIFO order: submission time (the
+        ticket file's mtime — set by the atomic commit), id as the
+        same-instant tiebreak.  Not lexical id alone: callers may
+        supply their own ``--request-id``, and a late 'a_urgent' must
+        not jump ahead of earlier generated ``req_...`` tickets."""
+        root = self.root / "pending"
+        if not root.is_dir():
+            return []
+
+        def _key(path: pathlib.Path):
+            try:
+                return (path.stat().st_mtime, path.name)
+            except OSError:  # claimed/vanished mid-scan: order last,
+                # claim() skips it when the rename fails
+                return (float("inf"), path.name)
+
+        return sorted(root.glob("*.json"), key=_key)
+
+    def depth(self) -> int:
+        return len(self.pending())
+
+    def claim(self) -> Optional[RequestTicket]:
+        """Claim the oldest pending request, or None when the queue is
+        empty.  Rename-based: losing a claim race to another worker is
+        silent (the next candidate is tried)."""
+        for path in self.pending():
+            target = self.root / "active" / path.name
+            try:
+                os.rename(path, target)
+            except OSError:
+                continue  # another worker won, or the ticket vanished
+            try:
+                return RequestTicket.from_json(target.read_bytes())
+            except (OSError, ValueError, TypeError) as exc:
+                # a malformed ticket must not wedge the queue: park it
+                # as failed with the parse error recorded
+                atomic_write_bytes(
+                    self._ticket_path("failed", path.stem),
+                    (json.dumps({"request_id": path.stem,
+                                 "status": "failed",
+                                 "error": f"unreadable ticket: {exc}"},
+                                indent=1) + "\n").encode())
+                try:
+                    target.unlink()
+                except OSError:
+                    pass
+        return None
+
+    def finish(self, ticket: RequestTicket, status: str,
+               error: Optional[str] = None,
+               results_dir: Optional[str] = None) -> pathlib.Path:
+        """Commit a claimed request's terminal state: final ticket into
+        ``done/`` (status ``ok``) or ``failed/`` (``failed`` /
+        ``refused``), a copy into the results tree, and the ``active/``
+        claim removed — in that order, so a crash mid-finish leaves the
+        claim visible rather than losing the request."""
+        ticket.status = status
+        ticket.error = error
+        ticket.results_dir = str(results_dir) if results_dir else None
+        state = "done" if status == "ok" else "failed"
+        final = self._ticket_path(state, ticket.request_id)
+        atomic_write_bytes(final, ticket.to_json())
+        if results_dir:
+            atomic_write_bytes(
+                pathlib.Path(results_dir) / "request.json",
+                ticket.to_json())
+        try:
+            self._ticket_path("active", ticket.request_id).unlink()
+        except OSError:
+            pass
+        return final
+
+    # -- inspection -------------------------------------------------------
+
+    def status(self, request_id: str) -> Optional[dict]:
+        """``{"state": ..., **ticket}`` for a request, or None when the
+        spool has never seen it."""
+        for state in ("done", "failed", "active", "pending"):
+            path = self._ticket_path(state, request_id)
+            if path.exists():
+                try:
+                    doc = json.loads(path.read_text())
+                except (OSError, ValueError):
+                    doc = {"request_id": request_id}
+                return {"state": state, **doc}
+        return None
+
+    def list_requests(self) -> List[dict]:
+        """Every known request's status dict, FIFO by id."""
+        seen = {}
+        for state in ("pending", "active", "done", "failed"):
+            root = self.root / state
+            if not root.is_dir():
+                continue
+            for path in root.glob("*.json"):
+                seen.setdefault(path.stem, state)
+        return [self.status(rid) for rid in sorted(seen)]

@@ -1,0 +1,441 @@
+"""The pertserve worker daemon: a long-lived, program-cache-resident
+inference loop over a file-queue spool.
+
+One worker process holds everything a cold CLI run pays for on every
+invocation — the Python/jax import, the in-process AOT program cache
+(``infer/svi.py``) and the warm XLA compile cache — RESIDENT, and
+drains queued requests through it.  Each request:
+
+1. is **admitted**: input shapes are probed and the request is padded
+   into the nearest shape bucket (``serve/buckets.py``); oversized
+   requests are refused, not compiled ad hoc;
+2. runs as one ordinary :class:`api.scRT` pipeline with per-request
+   everything — RunLog (``results/<id>/run.jsonl``, stamped with the
+   request id), metrics registry (the log-scoped seam keeps it from
+   cross-feeding the worker's own registry), and durable-run
+   checkpoint dir (``results/<id>/ckpt``) — so the whole
+   fault-tolerance ladder (transient retry, OOM degrade, watchdog,
+   NaN escalation) applies per request;
+3. is **isolated**: an exception escaping one request fails THAT
+   request's ticket/manifest and the worker moves on — the injected
+   ``oom@step2/fit#1`` chaos case in tests/test_serve.py pins that a
+   faulted request leaves a concurrently queued one bit-identical to
+   its golden run;
+4. streams results back: ``output.tsv``/``supp.tsv`` (+ the G1 pair
+   when step 3 runs), ``cell_qc.tsv``, the request RunLog, and a
+   terminal ticket.
+
+The worker emits schema-v7 ``request_start``/``request_end`` events on
+its own RunLog and feeds the worker gauges (``pert_serve_queue_depth``,
+``pert_serve_requests_total``, ``pert_serve_bucket_pad_frac``) through
+the same emit seam; its Prometheus textfile
+(``--metrics-textfile``) is the scrape surface PR 9 built for exactly
+this resident process.  SIGTERM/SIGINT request a graceful drain: the
+in-flight request completes, pending tickets stay queued for the next
+worker, and the worker log closes cleanly.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import os
+import signal
+import threading
+import time
+from typing import Optional
+
+import pandas as pd
+
+from scdna_replication_tools_tpu.obs import metrics as metrics_mod
+from scdna_replication_tools_tpu.obs.runlog import RunLog
+from scdna_replication_tools_tpu.obs.summary import summarize_run
+from scdna_replication_tools_tpu.serve.buckets import (
+    BucketRefusal,
+    BucketSet,
+)
+from scdna_replication_tools_tpu.serve.queue import (
+    RequestTicket,
+    SpoolQueue,
+)
+from scdna_replication_tools_tpu.utils import faults as faults_mod
+from scdna_replication_tools_tpu.utils.profiling import logger
+
+# The subset of scRT keyword arguments a request ticket may override.
+# A whitelist, not passthrough: a ticket is external input, and an
+# arbitrary kwarg would let one tenant reconfigure the worker's
+# execution substrate (telemetry/checkpoint paths, sharding) out from
+# under every other request.  Shape-affecting knobs stay out too —
+# bucket padding owns the shapes.
+REQUEST_OPTION_KEYS = frozenset({
+    "input_col", "assign_col", "clone_col", "cn_prior_method",
+    "cn_prior_weight", "rt_prior_col", "max_iter", "min_iter",
+    "rel_tol", "learning_rate", "seed", "run_step3", "mirror_rescue",
+    "qc", "qc_entropy_thresh", "qc_ppc_z", "controller",
+    "controller_max_extra_iters", "faults", "resume",
+    "clustering_method", "cn_hmm_self_prob",
+})
+
+
+_WORKER_LOG_COUNTER = itertools.count()
+
+# recent-outcome window kept in memory (`ServeWorker.outcomes`): big
+# enough for every bench/smoke/test harness (they bound the loop with
+# max_requests anyway), bounded so the production daemon's RSS is flat
+RECENT_OUTCOMES = 256
+
+
+@dataclasses.dataclass
+class RequestOutcome:
+    request_id: str
+    status: str                 # ok / failed / refused
+    wall_seconds: float
+    bucket: Optional[dict] = None
+    error: Optional[str] = None
+    run_log: Optional[str] = None
+    compile_cache: Optional[dict] = None
+
+
+class ServeWorker:
+    """See module docstring.  ``max_requests``/``exit_when_idle`` bound
+    the loop for CI/bench harnesses; a production worker runs with
+    neither and drains on signal."""
+
+    def __init__(self, queue: SpoolQueue,
+                 buckets: Optional[BucketSet] = None,
+                 telemetry_path: Optional[str] = None,
+                 metrics_textfile: Optional[str] = None,
+                 poll_interval: float = 0.5,
+                 max_requests: Optional[int] = None,
+                 exit_when_idle: bool = False,
+                 default_options: Optional[dict] = None):
+        self.queue = queue
+        self.buckets = buckets or BucketSet()
+        self.poll_interval = float(poll_interval)
+        self.max_requests = max_requests
+        self.exit_when_idle = bool(exit_when_idle)
+        self.default_options = dict(default_options or {})
+        # fail FAST on bad worker defaults: they apply to every
+        # request, and a reserved key (telemetry_path, checkpoint_dir,
+        # pad_*, request_id — the per-request kwargs the worker itself
+        # owns) would otherwise TypeError inside scRT on each request
+        # instead of at startup; ticket options are merely warned-and-
+        # filtered (external input), but the operator's own flags
+        # deserve a loud refusal
+        bad = sorted(set(self.default_options) - REQUEST_OPTION_KEYS)
+        if bad:
+            raise ValueError(
+                f"worker default option(s) {bad} are not requestable "
+                f"scRT knobs (whitelist: serve/worker.py "
+                f"REQUEST_OPTION_KEYS; telemetry/checkpoint/padding/"
+                f"request-identity paths are owned by the worker)")
+        self._draining = False
+        # bounded: a production daemon processes requests forever, and
+        # an unbounded outcome list would be a slow memory leak; the
+        # full per-request record lives in the worker log + tickets,
+        # this keeps only the recent window (+ running counters)
+        self.outcomes: collections.deque = collections.deque(
+            maxlen=RECENT_OUTCOMES)
+        self._status_counts: dict = {}
+        queue.ensure_dirs()
+        if telemetry_path is None:
+            # pid + counter in the default name: multiple workers may
+            # share one spool (the queue's rename-based claiming
+            # exists for that), and RunLog opens its file with "w" —
+            # a same-second collision would clobber a sibling's
+            # request audit trail
+            telemetry_path = str(
+                queue.root / f"worker_{time.strftime('%Y%m%d_%H%M%S')}"
+                             f"_{os.getpid()}"
+                             f"_{next(_WORKER_LOG_COUNTER)}.jsonl")
+        self.telemetry_path = telemetry_path
+        self.registry = metrics_mod.MetricsRegistry.create(
+            textfile_path=metrics_textfile)
+        self.worker_log = RunLog.create(telemetry_path,
+                                        run_name="pert_serve")
+        # log-scoped registry routing: the worker log's events (incl.
+        # request_start/request_end) feed THIS registry, while each
+        # request's own log feeds its own — no cross-feeding even
+        # though both are live in one process
+        self.worker_log.metrics_registry = self.registry
+
+    # -- lifecycle --------------------------------------------------------
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT -> graceful drain.  Main thread only (signal
+        module restriction); harnesses running the worker in a thread
+        install these themselves and call :meth:`request_drain`."""
+        signal.signal(signal.SIGTERM, self.request_drain)
+        signal.signal(signal.SIGINT, self.request_drain)
+
+    def request_drain(self, signum=None, frame=None) -> None:
+        """Finish the in-flight request, leave the queue intact, exit
+        the loop.  Idempotent; safe from signal handlers and threads."""
+        if not self._draining:
+            logger.warning(
+                "pert-serve: drain requested (%s) — finishing the "
+                "in-flight request, leaving pending tickets queued",
+                f"signal {signum}" if signum is not None else "api")
+        self._draining = True
+
+    def _sleep_poll(self) -> None:
+        """Sleep one poll interval in small increments so a drain
+        request during an idle wait is honoured promptly."""
+        deadline = time.monotonic() + self.poll_interval
+        while not self._draining and time.monotonic() < deadline:
+            time.sleep(min(0.05, self.poll_interval))
+
+    def run(self) -> dict:
+        """Drain the spool until stopped; returns the session stats."""
+        if threading.current_thread() is threading.main_thread():
+            self.install_signal_handlers()
+        processed = 0
+        config = {
+            "spool": str(self.queue.root),
+            "buckets": self.buckets.describe(),
+            "poll_interval": self.poll_interval,
+            "max_requests": self.max_requests,
+            "exit_when_idle": self.exit_when_idle,
+            "default_options": self.default_options,
+        }
+        with self.worker_log.session(config=config,
+                                     run_name="pert_serve"):
+            while not self._draining:
+                if self.max_requests is not None \
+                        and processed >= self.max_requests:
+                    break
+                ticket = self.queue.claim()
+                if ticket is None:
+                    if self.exit_when_idle:
+                        break
+                    self._sleep_poll()
+                    continue
+                outcome = self.process_request(ticket)
+                self.outcomes.append(outcome)
+                self._status_counts[outcome.status] = \
+                    self._status_counts.get(outcome.status, 0) + 1
+                processed += 1
+                self.registry.write_textfile()
+        self.registry.write_textfile()
+        return {
+            "processed": processed,
+            "by_status": dict(self._status_counts),
+            "drained": self._draining,
+            "pending_left": self.queue.depth(),
+            "worker_log": self.worker_log.path,
+            "outcomes": [dataclasses.asdict(o) for o in self.outcomes],
+        }
+
+    # -- one request ------------------------------------------------------
+
+    def _probe_shape(self, df_s: pd.DataFrame, df_g1: pd.DataFrame,
+                     options: dict) -> dict:
+        cell_col = options.get("cell_col", "cell_id")
+        chr_col = options.get("chr_col", "chr")
+        start_col = options.get("start_col", "start")
+        return {
+            "num_cells_s": int(df_s[cell_col].nunique()),
+            "num_cells_g1": int(df_g1[cell_col].nunique()),
+            "num_loci": int(df_s[[chr_col, start_col]]
+                            .drop_duplicates().shape[0]),
+        }
+
+    def _merged_options(self, ticket: RequestTicket) -> dict:
+        options = dict(self.default_options)
+        unknown = sorted(set(ticket.options) - REQUEST_OPTION_KEYS)
+        if unknown:
+            logger.warning(
+                "pert-serve: request %s carries non-whitelisted "
+                "option(s) %s — ignored (see serve/worker.py "
+                "REQUEST_OPTION_KEYS)", ticket.request_id, unknown)
+        options.update({k: v for k, v in ticket.options.items()
+                        if k in REQUEST_OPTION_KEYS})
+        return options
+
+    def process_request(self, ticket: RequestTicket) -> RequestOutcome:
+        rid = ticket.request_id
+        results_dir = self.queue.results_dir(rid)
+        results_dir.mkdir(parents=True, exist_ok=True)
+        t0 = time.perf_counter()
+        depth = self.queue.depth()
+        options = self._merged_options(ticket)
+        bucket = None
+        try:
+            df_s = pd.read_csv(ticket.s_path, sep="\t",
+                               dtype={"chr": str})
+            df_g1 = pd.read_csv(ticket.g1_path, sep="\t",
+                                dtype={"chr": str})
+            shape = self._probe_shape(df_s, df_g1, options)
+            bucket = self.buckets.select(
+                max(shape["num_cells_s"], shape["num_cells_g1"]),
+                shape["num_loci"])
+            pad_frac = bucket.pad_frac(
+                max(shape["num_cells_s"], shape["num_cells_g1"]),
+                shape["num_loci"])
+            self.worker_log.emit(
+                "request_start", request_id=rid,
+                bucket={"name": bucket.name, "cells": bucket.cells,
+                        "loci": bucket.loci},
+                pad_frac=round(pad_frac, 6), queue_depth=depth,
+                shape=shape)
+        except BucketRefusal as exc:
+            wall = time.perf_counter() - t0
+            self.worker_log.emit(
+                "request_start", request_id=rid, bucket=None,
+                pad_frac=None, queue_depth=depth,
+                detail="refused at admission")
+            self.worker_log.emit(
+                "request_end", request_id=rid, status="refused",
+                wall_seconds=round(wall, 4), error=str(exc)[:500])
+            self.queue.finish(ticket, "refused", error=str(exc),
+                              results_dir=results_dir)
+            logger.warning("pert-serve: request %s refused: %s", rid,
+                           exc)
+            return self._record(rid, "refused", wall, error=str(exc))
+        except Exception as exc:
+            # unreadable/malformed input: fail the request at
+            # admission.  Still open the lifecycle pair — the worker
+            # log's contract is one request_start per request_end, and
+            # a consumer joining starts to ends must not see orphans
+            wall = time.perf_counter() - t0
+            self.worker_log.emit(
+                "request_start", request_id=rid, bucket=None,
+                pad_frac=None, queue_depth=depth,
+                detail="failed at admission")
+            self.worker_log.emit(
+                "request_end", request_id=rid, status="failed",
+                wall_seconds=round(wall, 4),
+                error=f"{type(exc).__name__}: {str(exc)[:400]}",
+                error_class="admission")
+            self.queue.finish(ticket, "failed", error=str(exc),
+                              results_dir=results_dir)
+            logger.warning("pert-serve: request %s failed at admission "
+                           "(%s)", rid, exc)
+            return self._record(rid, "failed", wall, error=str(exc))
+
+        bucket_info = {"name": bucket.name, "cells": bucket.cells,
+                       "loci": bucket.loci}
+        run_log_path = str(results_dir / "run.jsonl")
+        try:
+            self._run_pipeline(rid, df_s, df_g1, options, bucket,
+                               results_dir, run_log_path)
+        except Exception as exc:
+            # PER-REQUEST FAULT ISOLATION: whatever escaped the
+            # pipeline — an OOM past the degradation ladder, a NaN
+            # escalation abort, a deterministic bug in one tenant's
+            # data — fails THIS request's ticket and manifest; the
+            # worker, its program cache and the rest of the queue
+            # carry on.  The scRT instance lives inside _run_pipeline,
+            # whose own handler already retired its registry
+            # (_cleanup_failed_request); here only the process-global
+            # fault plan is left to clear.
+            faults_mod.install(None)
+            wall = time.perf_counter() - t0
+            kind = faults_mod.classify_exception(exc)
+            self.worker_log.emit(
+                "request_end", request_id=rid, status="failed",
+                wall_seconds=round(wall, 4), bucket=bucket_info,
+                error=f"{type(exc).__name__}: {str(exc)[:400]}",
+                error_class=kind, run_log=run_log_path,
+                results_dir=str(results_dir),
+                detail=("request isolated: the per-request durable-run "
+                        "artifacts (checkpoints, RunLog, manifest) "
+                        "carry the post-mortem; the worker and queue "
+                        "continue"))
+            self.queue.finish(ticket, "failed",
+                              error=f"{type(exc).__name__}: "
+                                    f"{str(exc)[:400]}",
+                              results_dir=results_dir)
+            logger.warning(
+                "pert-serve: request %s failed (%s: %s) — worker "
+                "continues", rid, kind, str(exc)[:200])
+            return self._record(rid, "failed", wall,
+                                bucket=bucket_info,
+                                error=f"{type(exc).__name__}: "
+                                      f"{str(exc)[:400]}",
+                                run_log=run_log_path)
+        except BaseException:
+            # a real preemption/KeyboardInterrupt: the PROCESS is going
+            # away — record what we can and propagate (the ticket stays
+            # in active/, visibly orphaned, for the operator)
+            self.request_drain()
+            raise
+
+        wall = time.perf_counter() - t0
+        summary = summarize_run(run_log_path) or {}
+        compile_cache = {
+            k: (summary.get("compile") or {}).get(k)
+            for k in ("programs", "cache_hits", "cache_misses",
+                      "hit_rate")
+        }
+        self.worker_log.emit(
+            "request_end", request_id=rid, status="ok",
+            wall_seconds=round(wall, 4), bucket=bucket_info,
+            run_log=run_log_path, results_dir=str(results_dir),
+            compile_cache=compile_cache)
+        self.queue.finish(ticket, "ok", results_dir=results_dir)
+        logger.info(
+            "pert-serve: request %s ok in %.1fs (bucket %s, compile "
+            "%s hit / %s miss)", rid, wall, bucket.name,
+            compile_cache.get("cache_hits"),
+            compile_cache.get("cache_misses"))
+        return self._record(rid, "ok", wall, bucket=bucket_info,
+                            run_log=run_log_path,
+                            compile_cache=compile_cache)
+
+    def _run_pipeline(self, rid: str, df_s, df_g1, options: dict,
+                      bucket, results_dir, run_log_path: str) -> None:
+        from scdna_replication_tools_tpu.api import scRT
+
+        scrt = scRT(
+            df_s, df_g1,
+            telemetry_path=run_log_path,
+            checkpoint_dir=str(results_dir / "ckpt"),
+            pad_cells_to=bucket.cells,
+            pad_loci_to=bucket.loci,
+            request_id=rid,
+            **options,
+        )
+        try:
+            cn_s_out, supp_s, cn_g1_out, supp_g1 = scrt.infer(
+                level="pert")
+        except BaseException:
+            self._cleanup_failed_request(scrt)
+            raise
+        cn_s_out.to_csv(results_dir / "output.tsv", sep="\t",
+                        index=False)
+        supp_s.to_csv(results_dir / "supp.tsv", sep="\t", index=False)
+        if cn_g1_out is not None and len(cn_g1_out):
+            cn_g1_out.to_csv(results_dir / "g1_output.tsv", sep="\t",
+                             index=False)
+            supp_g1.to_csv(results_dir / "g1_supp.tsv", sep="\t",
+                           index=False)
+        if scrt._cell_qc_df is not None:
+            scrt.cell_qc().to_csv(results_dir / "cell_qc.tsv",
+                                  sep="\t", index=False)
+
+    def _cleanup_failed_request(self, scrt) -> None:
+        """A failed request must not leak process-global state into its
+        successors: retire its registry from the install seam (on the
+        success path the facade does this itself) and clear any fault
+        plan its config installed — the next request's runner installs
+        its own, but worker-level code between requests must not trip
+        a dead tenant's chaos spec."""
+        try:
+            registry = getattr(scrt, "metrics_registry", None)
+            if registry is not None:
+                metrics_mod.uninstall(registry)
+        except Exception:  # pertlint: disable=PL011 — cleanup of a
+            # failed request is best-effort by definition; the failure
+            # itself is already being reported by the caller
+            pass
+        faults_mod.install(None)
+
+    def _record(self, rid: str, status: str, wall: float,
+                bucket=None, error=None, run_log=None,
+                compile_cache=None) -> RequestOutcome:
+        return RequestOutcome(
+            request_id=rid, status=status,
+            wall_seconds=round(wall, 4), bucket=bucket, error=error,
+            run_log=run_log, compile_cache=compile_cache)

@@ -67,9 +67,10 @@ scope says — so a 2-host chaos scenario can preempt exactly one host
 while the other survives to the barrier.
 
 Site names are stable strings owned by the call sites:
-``{step}/start``, ``{step}/chunk``, ``{step}/save``, ``{step}/end``,
-``compile``, ``{prefix}/decode``, ``qc/ppc`` (see OBSERVABILITY.md,
-"Durable runs").
+``{step}/start``, ``{step}/fit`` (the step-fit dispatch — the serve
+suite's per-request isolation site), ``{step}/chunk``, ``{step}/save``,
+``{step}/end``, ``compile``, ``{prefix}/decode``, ``qc/ppc`` (see
+OBSERVABILITY.md, "Durable runs").
 """
 
 from __future__ import annotations

@@ -361,6 +361,105 @@ def test_phase_sink_chains_with_runlog_session(tmp_path):
     assert series.value is not None and series.value >= 0.0
 
 
+def test_interleaved_logs_feed_their_own_registries(tmp_path):
+    """Two logs active in ONE process (the serving worker's shape:
+    worker-level log + per-request logs) must never cross-feed — the
+    emit seam resolves the LOG-OWNED registry first and falls back to
+    the process-global seam only for registry-less logs."""
+    reg_a = MetricsRegistry.create()
+    reg_b = MetricsRegistry.create()
+    reg_global = MetricsRegistry.create()
+    metrics_mod.install(reg_global)
+    log_a = RunLog(str(tmp_path / "a.jsonl"))
+    log_a.metrics_registry = reg_a
+    log_b = RunLog(str(tmp_path / "b.jsonl"))
+    log_b.metrics_registry = reg_b
+    log_bare = RunLog(None)  # no owned registry: global fallback
+    log_a.open_run()
+    log_b.open_run()
+    # interleaved emission, the two-requests-in-one-worker pattern
+    log_a.emit("fit_end", step="step2", iters=100, converged=True,
+               nan_abort=False, wall_seconds=1.0)
+    log_b.emit("fit_end", step="step2", iters=7, converged=False,
+               nan_abort=False, wall_seconds=1.0)
+    log_a.emit("retry", label="x", attempt=1)
+    log_b.emit("degrade", action="drop_ppc")
+    log_bare.emit("retry", label="y", attempt=1)
+    log_a.close_run()
+    log_b.close_run()
+
+    a_iters = reg_a.counter("pert_fit_iters_total",
+                            labels={"step": "step2"}).value
+    b_iters = reg_b.counter("pert_fit_iters_total",
+                            labels={"step": "step2"}).value
+    assert (a_iters, b_iters) == (100, 7)
+    assert reg_a.counter("pert_retries_total").value == 1
+    assert reg_b.counter("pert_retries_total").value in (None, 0)
+    assert reg_a.counter("pert_degrades_total",
+                         labels={"action": "drop_ppc"}).value \
+        in (None, 0)
+    assert reg_b.counter("pert_degrades_total",
+                         labels={"action": "drop_ppc"}).value == 1
+    # the registry-less log fed the global seam, and ONLY it
+    assert reg_global.counter("pert_retries_total").value == 1
+    assert reg_global.counter("pert_fit_iters_total",
+                              labels={"step": "step2"}).value \
+        in (None, 0)
+
+
+def test_phase_sink_pinned_registry_does_not_cross_feed():
+    """attach_phase_sink(timer, registry=...) routes that timer's
+    phases into exactly that registry, regardless of what the
+    process-global seam points at — and re-attaching with a different
+    registry REPLACES the metrics sink instead of stacking a second
+    one (two chained sinks would double-feed two registries)."""
+    reg_a = MetricsRegistry.create()
+    reg_b = MetricsRegistry.create()
+    metrics_mod.install(reg_b)  # the global seam points elsewhere
+    timer_a = PhaseTimer()
+    attach_phase_sink(timer_a, registry=reg_a)
+    attach_phase_sink(timer_a, registry=reg_a)  # idempotent per pair
+    timer_a.add("stage/a", 1.0)
+    key_a = ("pert_phase_seconds_total", (("phase", "stage/a"),))
+    assert reg_a.counter("pert_phase_seconds_total",
+                         labels={"phase": "stage/a"}).value == 1.0
+    assert key_a not in reg_b._series
+
+    # re-scope the SAME timer to reg_b: reg_a must stop receiving
+    attach_phase_sink(timer_a, registry=reg_b)
+    timer_a.add("stage/a", 2.0)
+    assert reg_a.counter("pert_phase_seconds_total",
+                         labels={"phase": "stage/a"}).value == 1.0
+    assert reg_b.counter("pert_phase_seconds_total",
+                         labels={"phase": "stage/a"}).value == 2.0
+
+
+def test_phase_sink_rescopes_under_an_open_session(tmp_path):
+    """Re-attaching while a RunLog session has chained its own sink on
+    TOP must re-scope the buried metrics sink in place — an
+    outermost-only replacement would stack a second sink and
+    double-feed both registries (and lose the new one when the session
+    restores the outer chain on exit)."""
+    reg_a = MetricsRegistry.create()
+    reg_b = MetricsRegistry.create()
+    timer = PhaseTimer()
+    attach_phase_sink(timer, registry=reg_a)
+    log = RunLog(str(tmp_path / "scoped.jsonl"))
+    key = ("pert_phase_seconds_total", (("phase", "stage/x"),))
+    with log.session(config={}, timer=timer):
+        # the session's sink now wraps the metrics sink
+        attach_phase_sink(timer, registry=reg_b)
+        timer.add("stage/x", 1.0)
+        assert key not in reg_a._series          # no double-feed
+        assert reg_b.counter("pert_phase_seconds_total",
+                             labels={"phase": "stage/x"}).value == 1.0
+    # the re-scoped sink survives the session's chain restoration
+    timer.add("stage/x", 2.0)
+    assert reg_b.counter("pert_phase_seconds_total",
+                         labels={"phase": "stage/x"}).value == 3.0
+    assert key not in reg_a._series
+
+
 def test_memory_stats_absent_backend_is_a_noop(monkeypatch):
     """A backend whose devices lack usable memory_stats (CPU returns
     None; others raise NotImplementedError) yields no device gauges and

@@ -8,11 +8,20 @@ tool closes that gap:
 
     python -m tools.pert_fleet index   [--roots DIR ...] [--out FILE]
     python -m tools.pert_fleet query   [--config-hash H] [--run-name N]
-                                       [--status S] [--since D] [--until D]
-    python -m tools.pert_fleet trend   [--metric M ...] [--out FILE]
+                                       [--status S] [--request ID|*]
+                                       [--since D] [--until D]
+    python -m tools.pert_fleet trend   [--metric M ...] [--request ID|*]
+                                       [--out FILE]
     python -m tools.pert_fleet regress --baseline FILE [--run LOG]
                                        [--tolerance-scale S]
                                        [--write-baseline FILE]
+
+Serve traffic rides the same machinery: pointing ``index --roots`` at
+a pert-serve spool directory ingests the worker log AND every
+per-request RunLog under its ``results/`` tree (they are ordinary
+``*.jsonl`` run logs, stamped with a ``request_id``), and ``query`` /
+``trend --request`` group on that id — ``--request '*'`` keeps every
+request-stamped run, a literal id keeps one request's runs.
 
 * ``index`` ingests every run log under the roots (default: the
   repo-local ``.pert_runs/`` plus ``artifacts/``) into one queryable
@@ -96,6 +105,10 @@ def run_record(path) -> Optional[dict]:
         "file": path.name,
         "mtime": mtime,
         "run_name": summary.get("run_name"),
+        # serve traffic (schema v7): per-request RunLogs under the
+        # worker's spool/results tree carry the request id in
+        # run_start — `query`/`trend` group on it via --request
+        "request_id": summary.get("request_id"),
         "schema_version": summary.get("schema_version"),
         "started_unix": summary.get("started_unix"),
         "config_hash": summary.get("config_hash"),
@@ -179,6 +192,13 @@ def filter_runs(runs: List[dict], args) -> List[dict]:
         out = [r for r in out if r.get("config_hash") == args.config_hash]
     if getattr(args, "run_name", None):
         out = [r for r in out if r.get("run_name") == args.run_name]
+    if getattr(args, "request", None):
+        # '*' keeps every run that IS a request (serve traffic only);
+        # a literal id keeps that request's runs
+        if args.request == "*":
+            out = [r for r in out if r.get("request_id")]
+        else:
+            out = [r for r in out if r.get("request_id") == args.request]
     if getattr(args, "status", None):
         out = [r for r in out if r.get("status") == args.status]
     if getattr(args, "since", None):
@@ -230,14 +250,15 @@ def sparkline(values) -> str:
 
 
 def render_query(runs: List[dict]) -> str:
-    lines = ["| run | when | status | platform | config | cells | "
-             "wall (s) |",
-             "|---|---|---|---|---|---:|---:|"]
+    lines = ["| run | when | status | platform | config | request | "
+             "cells | wall (s) |",
+             "|---|---|---|---|---|---|---:|---:|"]
     for r in runs:
         lines.append(
             f"| `{r.get('file')}` | {_fmt_time(r)} | {r.get('status')} "
             f"| {r.get('platform') or '-'} "
             f"| `{r.get('config_hash') or '-'}` "
+            f"| {r.get('request_id') or '-'} "
             f"| {_fmt_val((r.get('workload') or {}).get('num_cells'))} "
             f"| {_fmt_val(r.get('wall_seconds'))} |")
     return "\n".join(lines)
@@ -443,6 +464,11 @@ def main(argv=None) -> int:
     p_query.add_argument("--config-hash", default=None)
     p_query.add_argument("--run-name", default=None)
     p_query.add_argument("--status", default=None)
+    p_query.add_argument("--request", default=None, metavar="ID",
+                         help="keep only serve-request runs: a request "
+                              "id, or '*' for every run that carries "
+                              "one (per-request RunLogs under a "
+                              "pert-serve spool/results tree)")
     p_query.add_argument("--since", default=None, metavar="YYYY-MM-DD")
     p_query.add_argument("--until", default=None, metavar="YYYY-MM-DD")
     p_query.add_argument("--json", action="store_true",
@@ -454,6 +480,10 @@ def main(argv=None) -> int:
     p_trend.add_argument("--config-hash", default=None)
     p_trend.add_argument("--run-name", default=None)
     p_trend.add_argument("--status", default=None)
+    p_trend.add_argument("--request", default=None, metavar="ID",
+                         help="trend serve-request runs only: a "
+                              "request id, or '*' for every run that "
+                              "carries one")
     p_trend.add_argument("--since", default=None, metavar="YYYY-MM-DD")
     p_trend.add_argument("--until", default=None, metavar="YYYY-MM-DD")
     p_trend.add_argument("--metric", nargs="+", default=None,

@@ -1,0 +1,23 @@
+"""Repo-local shim for the ``pert-serve`` console entry.
+
+The implementation lives in the installable package
+(``scdna_replication_tools_tpu/serve/cli.py`` — the ``pert-serve``
+console script in pyproject.toml); this wrapper exists so repo
+checkouts driven without a ``pip install -e .`` (CI steps, the TPU
+window runner) can invoke the same CLI as ``python tools/pert_serve.py
+...``, mirroring the other tools/ entry points.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from scdna_replication_tools_tpu.serve.cli import (  # noqa: E402
+    console_main,
+)
+
+if __name__ == "__main__":
+    sys.exit(console_main())

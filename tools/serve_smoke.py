@@ -1,0 +1,214 @@
+"""Serve smoke: end-to-end proof of the pertserve worker's three core
+claims, runnable on CPU in CI (the serve-smoke job) and locally.
+
+One worker session over four queued requests:
+
+1. **r1 (clean)** — the cold request: compiles the bucket's programs
+   (compile-cache misses expected);
+2. **r2 (faulted)** — carries ``faults='oom@step2/fit#1'``: the
+   injected OOM escapes the step-2 fit, the durable-run ladder audits
+   ``abort_resumable`` in r2's own RunLog, and the WORKER SURVIVES —
+   per-request fault isolation;
+3. **r3 (clean, same bucket)** — the warm request: must be a 100%
+   program-cache hit (ZERO compile misses in its RunLog) and its
+   outputs must be BIT-IDENTICAL to a golden direct ``scRT`` run of
+   the same frames under the same bucket padding — a faulted
+   neighbour request corrupts nothing;
+4. **r4 (mismatched shape)** — larger than the worker's largest
+   bucket: refused at admission, never compiled.
+
+Writes a JSON verdict (``--out``), copies r3's RunLog to
+``<workdir>/warm_request.jsonl`` (the CI fleet-regress step gates its
+compile-cache metrics against the committed
+``artifacts/FLEET_BASELINE_serve_cpu.json``), and renders r3's
+markdown report (``--report``) via tools/pert_report.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import shutil
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+
+
+REQUEST_OPTIONS = {
+    "max_iter": 150, "min_iter": 50, "run_step3": False,
+    "mirror_rescue": False, "seed": 0, "cn_prior_method": "g1_clones",
+}
+# mirror_rescue off: the rescue sub-fit's program is shaped by the
+# CANDIDATE COUNT, which varies per cohort — a warm request with a
+# different candidate count would honestly re-compile that one
+# program.  The bucket contract covers the batch-shaped programs; the
+# smoke pins exactly that (see OBSERVABILITY.md "Serving").
+
+
+def _frames(num_loci, cells_per_clone, seed):
+    from accuracy_sweep import _tutorial
+
+    tut = _tutorial()
+    df_s, df_g = tut.make_input_frames(num_loci=num_loci,
+                                       cells_per_clone=cells_per_clone,
+                                       seed=seed)
+    return tut.simulate_pert_frames(df_s, df_g, num_reads=8000,
+                                    lamb=0.75, a=10.0, seed=seed + 1)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--workdir", default="serve_smoke")
+    ap.add_argument("--out", default=None,
+                    help="write the JSON verdict here too")
+    ap.add_argument("--report", default=None,
+                    help="render r3's run log to markdown here")
+    ap.add_argument("--loci", type=int, default=48)
+    ap.add_argument("--cells-per-clone", type=int, default=4)
+    args = ap.parse_args(argv)
+
+    from scdna_replication_tools_tpu.api import scRT
+    from scdna_replication_tools_tpu.obs.schema import validate_run
+    from scdna_replication_tools_tpu.obs.summary import summarize_run
+    from scdna_replication_tools_tpu.serve import (
+        BucketSet,
+        ServeWorker,
+        SpoolQueue,
+    )
+
+    workdir = pathlib.Path(args.workdir)
+    workdir.mkdir(parents=True, exist_ok=True)
+    queue = SpoolQueue(workdir / "spool")
+
+    # small ladders so the mismatched-shape refusal is cheap to build:
+    # the largest bucket holds the smoke cohort, r4 overflows the loci
+    # ladder
+    buckets = BucketSet(cells=(8, 16, 32), loci=(64, 128))
+
+    sim_a = _frames(args.loci, args.cells_per_clone, seed=3)
+    sim_b = _frames(args.loci, args.cells_per_clone, seed=11)
+    sim_big = _frames(256, args.cells_per_clone, seed=5)
+
+    r1 = queue.submit_frames(*sim_a, options=REQUEST_OPTIONS,
+                             request_id="r1_cold")
+    r2 = queue.submit_frames(
+        *sim_a, options={**REQUEST_OPTIONS,
+                         "faults": "oom@step2/fit#1"},
+        request_id="r2_faulted")
+    r3 = queue.submit_frames(*sim_b, options=REQUEST_OPTIONS,
+                             request_id="r3_warm")
+    r4 = queue.submit_frames(*sim_big, options=REQUEST_OPTIONS,
+                             request_id="r4_oversized")
+
+    worker = ServeWorker(
+        queue, buckets=buckets, max_requests=4, exit_when_idle=True,
+        metrics_textfile=str(workdir / "pert_serve.prom"))
+    stats = worker.run()
+
+    failures = []
+
+    def check(ok, label):
+        (failures.append(label) if not ok else None)
+        print(("ok    " if ok else "FAIL  ") + label)
+
+    by_id = {o["request_id"]: o for o in stats["outcomes"]}
+    check(stats["processed"] == 4, "worker processed all 4 requests")
+    check(by_id.get(r1, {}).get("status") == "ok", "r1 (cold) ok")
+    check(by_id.get(r2, {}).get("status") == "failed",
+          "r2 (injected oom@step2/fit#1) failed in isolation")
+    check(by_id.get(r3, {}).get("status") == "ok",
+          "r3 (warm) ok AFTER the faulted request — worker survived")
+    check(by_id.get(r4, {}).get("status") == "refused",
+          "r4 (oversized) refused at admission")
+
+    cold_cache = by_id.get(r1, {}).get("compile_cache") or {}
+    warm_cache = by_id.get(r3, {}).get("compile_cache") or {}
+    check((cold_cache.get("cache_misses") or 0) > 0,
+          "r1 paid compile misses (cold)")
+    check(warm_cache.get("cache_misses") == 0
+          and (warm_cache.get("cache_hits") or 0) > 0,
+          "r3 is a 100% program-cache hit (zero compile misses)")
+
+    # schema validity: the worker log (request lifecycle events) and
+    # the warm request's own log
+    worker_errors = validate_run(stats["worker_log"])
+    check(worker_errors == [], "worker RunLog is schema-valid (v7)")
+    r3_log = by_id.get(r3, {}).get("run_log")
+    r3_errors = validate_run(r3_log) if r3_log else ["missing"]
+    check(r3_errors == [], "r3 RunLog is schema-valid")
+
+    # r2's own artifacts carry the fault audit
+    r2_summary = summarize_run(by_id.get(r2, {}).get("run_log")) or {}
+    resil = r2_summary.get("resilience") or {}
+    check(any(f.get("kind") == "oom" for f in resil.get("faults", [])),
+          "r2 RunLog audits the injected oom fault")
+
+    # golden parity: direct scRT on r3's frames under the SAME bucket
+    # padding — the warm serve path must be bit-identical to it
+    bucket = by_id.get(r3, {}).get("bucket") or {}
+    scrt = scRT(sim_b[0].copy(), sim_b[1].copy(),
+                telemetry_path=str(workdir / "golden.jsonl"),
+                pad_cells_to=bucket.get("cells"),
+                pad_loci_to=bucket.get("loci"),
+                **REQUEST_OPTIONS)
+    golden_out, _, _, _ = scrt.infer(level="pert")
+
+    import pandas as pd
+
+    served = pd.read_csv(
+        queue.results_dir(r3) / "output.tsv", sep="\t",
+        dtype={"chr": str})
+    g = golden_out.sort_values(["cell_id", "chr", "start"]) \
+        .reset_index(drop=True)
+    s = served.sort_values(["cell_id", "chr", "start"]) \
+        .reset_index(drop=True)
+    check(len(g) == len(s) and len(s) > 0,
+          "served output covers the golden rows")
+    import numpy as np
+
+    # compare at the output's native float32 precision: the served
+    # side round-trips through a TSV (shortest-repr float text), which
+    # is exact at float32 but not against the float64 the reader
+    # parses into
+    tau_equal = bool((g["model_tau"].to_numpy(np.float32)
+                      == s["model_tau"].to_numpy(np.float32)).all())
+    cn_equal = bool((g["model_cn_state"].to_numpy()
+                     == s["model_cn_state"].to_numpy()).all())
+    check(tau_equal, "r3 model_tau bit-identical to the golden run")
+    check(cn_equal, "r3 model_cn_state identical to the golden run")
+
+    check((queue.results_dir(r3) / "cell_qc.tsv").exists(),
+          "r3 per-request cell_qc table streamed back")
+
+    # stable copy of the warm request's log for the CI fleet gate
+    if r3_log:
+        shutil.copy(r3_log, workdir / "warm_request.jsonl")
+
+    if args.report and r3_log:
+        from pert_report import render_report
+
+        pathlib.Path(args.report).write_text(render_report(r3_log))
+
+    verdict = {
+        "metric": "pert_serve_smoke",
+        "ok": not failures,
+        "failures": failures,
+        "stats": {k: v for k, v in stats.items() if k != "outcomes"},
+        "outcomes": stats["outcomes"],
+        "cold_compile_cache": cold_cache,
+        "warm_compile_cache": warm_cache,
+        "warm_request_log": str(workdir / "warm_request.jsonl"),
+        "parity": {"tau_bit_identical": tau_equal,
+                   "cn_identical": cn_equal},
+    }
+    print(json.dumps(verdict))
+    if args.out:
+        pathlib.Path(args.out).write_text(
+            json.dumps(verdict, indent=1) + "\n")
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -104,6 +104,16 @@ battery() {  # returns 0 only if every step it attempted succeeded
     run_one BENCH_r10_enum_ab_tpu platform 1500 \
         python bench.py --enum-ab --platform tpu --budget full \
             --ab-out artifacts/BENCH_r10_enum_ab_tpu.json || return 1
+    # serving A/B on the chip (PR 12): N queued requests through one
+    # resident shape-bucketed worker vs N cold CLI runs — on TPU the
+    # cold arm's per-run trace+compile is multi-seconds-per-program
+    # (the r5 profile), so this is where the residency win is
+    # measured, not modelled; the committed CPU artifact
+    # (BENCH_r12_serve_ab_cpu.json) is the regression anchor
+    run_one BENCH_r12_serve_ab_tpu platform 2400 \
+        python bench.py --serve-ab --platform tpu \
+            --ab-out artifacts/BENCH_r12_serve_ab_tpu.json \
+            --metrics-textfile artifacts/METRICS_serve_tpu.prom || return 1
     run_one FULL_PIPELINE_r06_rescue_tpu platform 1500 \
         python tools/full_pipeline_bench.py --run-step3 --mirror-rescue \
             --checkpoint-dir artifacts/ckpt_r06_rescue $DURABLE \
