@@ -903,9 +903,25 @@ def _serve_ab_warm_arm(cohorts, options, workdir, args):
     misses = sum(int((o["compile_cache"] or {}).get("cache_misses")
                      or 0) for o in ok)
     last = ok[-1]
+    # span-decomposed latency (the causal-tracing tentpole): the worker
+    # traces by default, so every request's p50/p99 decomposes into
+    # queue-wait / admission / pad / compile / fit / decode /
+    # stream-back — the worker log carries the spool-side spans, each
+    # request's own run log the pipeline-side ones, stitched by the
+    # ticket's trace id
+    from pert_trace import log_spans, request_waterfall
+
+    worker_spans = log_spans(stats["worker_log"])["spans"]
+    waterfalls = {
+        o["request_id"]: request_waterfall(
+            None, o["run_log"], request_id=o["request_id"],
+            worker_spans=worker_spans)
+        for o in ok
+    }
     return {
         "arm": "warm_worker",
         "requests": len(ok),
+        "span_waterfalls": waterfalls,
         "total_wall_seconds": round(total, 2),
         "requests_per_second": round(len(ok) / max(total, 1e-9), 4),
         "latency_p50_seconds": round(_percentile(latencies, 50), 2),
@@ -951,6 +967,26 @@ def run_serve_ab(args):
     assert (last_cache.get("cache_misses") or 0) == 0, (
         "warm arm's last request paid compile misses — the bucket "
         f"residency contract is broken: {last_cache}")
+    # the span waterfall is part of the artifact's contract: every warm
+    # request decomposes into the full component vocabulary, and the
+    # fit component is real time (a zero fit would mean the spans never
+    # reached the request's run log — a broken trace handoff)
+    from pert_trace import WATERFALL_COMPONENTS
+
+    for rid, wf in warm["span_waterfalls"].items():
+        # request_waterfall always returns the full component
+        # vocabulary, so the teeth are VALUES, not keys: the request
+        # span must exist (total), the spool-side spans must be real
+        # (every request waited at least the submit→claim gap and
+        # streamed results back), and the trace HANDOFF must have
+        # landed the pipeline's spans in the request's own log (fit)
+        assert set(WATERFALL_COMPONENTS) <= set(wf)
+        assert wf["total_seconds"], (f"request {rid}: no 'request' "
+                                     f"span in the worker log: {wf}")
+        assert wf["queue_wait"] > 0 and wf["stream_back"] > 0, (
+            f"request {rid}: spool-side spans missing: {wf}")
+        assert wf["fit"] > 0, (f"request {rid}: span waterfall has no "
+                               f"fit time — trace handoff broken: {wf}")
 
     result = {
         "metric": "pert_serve_ab",

@@ -125,6 +125,7 @@ class scRT:
                  watchdog_compile_seconds=None,
                  watchdog_chunk_seconds=None, elastic_mesh=True,
                  pad_cells_to=None, pad_loci_to=None, request_id=None,
+                 trace_spans=False, trace_parent=None,
                  enum_impl='auto', fused_adam='auto',
                  optimizer_state_dtype='float32', cn_hmm_self_prob=None,
                  rho_from_rt_prior=False, mirror_rescue=True,
@@ -169,6 +170,7 @@ class scRT:
             elastic_mesh=elastic_mesh,
             pad_cells_to=pad_cells_to, pad_loci_to=pad_loci_to,
             request_id=request_id,
+            trace_spans=trace_spans, trace_parent=trace_parent,
             enum_impl=enum_impl, fused_adam=fused_adam,
             optimizer_state_dtype=optimizer_state_dtype,
             cn_hmm_self_prob=cn_hmm_self_prob,
@@ -278,6 +280,16 @@ class scRT:
             self.metrics_registry = registry
             run_log = RunLog.create(self.config.telemetry_path)
         run_log.metrics_registry = registry
+        if self.config.trace_spans:
+            # causal span tracing (obs/spans.py): the facade owns the
+            # log, so it attaches the tracer (the runner defers to an
+            # already-attached one) and points the span phase sink at
+            # ITS timer — the one every phase of this run accumulates
+            # into.  The session below opens the root 'run' span.
+            from scdna_replication_tools_tpu.obs import spans as spans_mod
+            spans_mod.attach_tracer(
+                run_log, spans_mod.tracer_for_run(self.config))
+            spans_mod.attach_phase_sink(timer, run_log.tracer)
         if self.config.request_id:
             # per-request identity for the fleet index (`--request`);
             # folded into run_start by the pending-context path

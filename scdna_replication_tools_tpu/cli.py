@@ -113,6 +113,20 @@ def infer_scrt_main(argv=None):
                         "pert_fleet query/trend --request groups on "
                         "it); excluded from the config hash "
                         "(PertConfig.request_id)")
+    p.add_argument("--trace-spans", action=BooleanOptionalAction,
+                   default=False,
+                   help="causal span tracing (default OFF): phases, fit "
+                        "chunks and the run itself become schema-v8 "
+                        "span_end events in the run log, exportable as "
+                        "a Perfetto timeline with tools/pert_trace.py "
+                        "(PertConfig.trace_spans); tracing-off logs "
+                        "carry no span bytes")
+    p.add_argument("--trace-parent", default=None,
+                   help="cross-process trace handoff "
+                        "'<trace_id>:<parent_span_id>' — this run's span "
+                        "tree stitches under that parent (the serving "
+                        "worker sets it per request; "
+                        "PertConfig.trace_parent)")
     p.add_argument("--mirror-rescue", action=BooleanOptionalAction,
                    default=True,
                    help="post-step-2 mirror-basin rescue for boundary-tau "
@@ -190,6 +204,8 @@ def infer_scrt_main(argv=None):
                 pad_cells_to=args.pad_cells_to,
                 pad_loci_to=args.pad_loci_to,
                 request_id=args.request_id,
+                trace_spans=args.trace_spans,
+                trace_parent=args.trace_parent,
                 mirror_rescue=args.mirror_rescue,
                 compile_cache_dir=args.compile_cache,
                 telemetry_path=args.telemetry,

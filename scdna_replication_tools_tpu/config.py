@@ -119,6 +119,24 @@ class PertConfig:
     # serve traffic by this id instead (`pert_fleet query/trend
     # --request`).  No behavioural effect.
     request_id: Optional[str] = None
+    # --- causal span tracing (obs/spans.py; OBSERVABILITY.md
+    # "Tracing") ---
+    # attach a span tracer to the run's RunLog: phases, fit chunks and
+    # the run itself become spans (schema v8 span_end events + a span
+    # envelope on every event), exportable as a Perfetto timeline via
+    # tools/pert_trace.py.  Default OFF: a tracing-off run's log
+    # carries no v8-specific bytes.  Span CONTENT is deterministic
+    # (ids, names, parentage, attrs); only wall-clock fields vary.
+    # Excluded from the config hash like telemetry_path — tracing is
+    # pure observability, and a traced/untraced pair of the same
+    # workload must hash equal.
+    trace_spans: bool = False
+    # cross-process trace handoff '<trace_id>:<parent_span_id>' (the
+    # serving worker stamps its request span here so the per-request
+    # run's span tree stitches under it); implies nothing when
+    # trace_spans is off.  Excluded from the config hash like
+    # request_id — it is pure per-request identity.
+    trace_parent: Optional[str] = None
     # write checkpoints at step boundaries (step1/step2/step3) to this dir.
     checkpoint_dir: Optional[str] = None
     # --- durable runs (see OBSERVABILITY.md "Durable runs & resume") ---

@@ -210,6 +210,21 @@ class PertInference:
         # the log's final run_end snapshot comes from THIS registry —
         # and the emit seam routes every event this log records into it
         self.run_log.metrics_registry = self.metrics
+        # causal span tracing (obs/spans.py): wire a tracer onto the
+        # log when the config asks for one and the caller (the facade)
+        # has not already attached it — phases become spans through the
+        # on_add chain, the chunk loop records fit/chunk spans via the
+        # runlog.current() seam, and RunLog.session opens the root
+        # 'run' span.  Tracing off = no tracer = a log with zero
+        # span bytes (the schema-v8 gating contract).
+        if config.trace_spans \
+                and getattr(self.run_log, "tracer", None) is None:
+            from scdna_replication_tools_tpu.obs import spans as spans_mod
+            spans_mod.attach_tracer(
+                self.run_log, spans_mod.tracer_for_run(config))
+        if getattr(self.run_log, "tracer", None) is not None:
+            from scdna_replication_tools_tpu.obs import spans as spans_mod
+            spans_mod.attach_phase_sink(self.phases, self.run_log.tracer)
         if config.request_id and run_log is None:
             # serving-worker identity: folded into run_start so the
             # fleet index can group per-request logs (`--request`).

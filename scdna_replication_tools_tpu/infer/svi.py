@@ -886,6 +886,25 @@ def _chunk_loop(*, run_chunk, params, opt_state, losses, diag, i_host,
     chunks_done = 0
     converged_flag = nan_flag = False
 
+    # causal span per dispatched chunk (obs/spans.py): the tracer rides
+    # the active RunLog — the same seam the compile events use — so the
+    # chunk loop needs no plumbing; None (tracing off) costs one
+    # attribute read per fit
+    tracer = getattr(_runlog.current(), "tracer", None)
+    chunk_t0 = chunk_t1 = 0.0
+
+    def _chunk_span(entry_it, i_now, action, verdict=None):
+        """One completed fit/chunk span carrying the controller's
+        verdict for the pass; everything but the wall-clock interval is
+        deterministic content."""
+        if tracer is None:
+            return
+        attrs = dict(chunk=chunks_done, iter_start=int(entry_it),
+                     iter_end=int(i_now), action=str(action))
+        if verdict:
+            attrs["verdict"] = str(verdict)
+        tracer.record_span("fit/chunk", chunk_t0, chunk_t1, **attrs)
+
     while i_host < budget:
         snap.update(
             params=params, opt_state=opt_state, losses_np=losses_np,
@@ -941,8 +960,10 @@ def _chunk_loop(*, run_chunk, params, opt_state, losses, diag, i_host,
             # the chunk watchdog exists to catch
             return out, int(out[0]), np.asarray(out[3])
 
+        chunk_t0 = time.time()
         out, i_host, losses_np = _faults.run_with_deadline(
             _dispatch, chunk_deadline, f"{escalate_tag} fit chunk")
+        chunk_t1 = time.time()
         (_, params, opt_state, losses, diag, converged, is_nan) = out
         chunks_done += 1
         if poison:
@@ -985,6 +1006,9 @@ def _chunk_loop(*, run_chunk, params, opt_state, losses, diag, i_host,
                                       + f"; checkpoint saved to "
                                         f"{ckpt_path}")
             decisions.append(decision)
+            _chunk_span(chunk_entry_it, i_host,
+                        decision.get("action", "escalate"),
+                        verdict="nan")
             if decision.get("outcome") != "retry":
                 break
             nan_retries += 1
@@ -1001,6 +1025,7 @@ def _chunk_loop(*, run_chunk, params, opt_state, losses, diag, i_host,
             continue
 
         if converged_flag:
+            _chunk_span(chunk_entry_it, i_host, "converged")
             break  # the reference's own rel-tol criterion fired
 
         d = _decode_diag(np.asarray(diag), i_host, diag_i0, diag_every)
@@ -1014,8 +1039,12 @@ def _chunk_loop(*, run_chunk, params, opt_state, losses, diag, i_host,
             extra_granted=extra_granted, prev_verdict=prev_verdict,
             stagnation_start=stagnation_anchor)
         if decision is None:
+            _chunk_span(chunk_entry_it, i_host, "continue",
+                        verdict=prev_verdict)
             continue
         action = decision["action"]
+        _chunk_span(chunk_entry_it, i_host, action,
+                    verdict=(decision.get("trigger") or {}).get("verdict"))
         if action == "early_stop":
             # hand back the BEST state seen, not whatever the last
             # chunk left: the noisy tails this stop fires on carry

@@ -27,7 +27,12 @@ logger lines.  This package turns every run into a diffable artifact:
   registry (counters / gauges / fixed-bucket histograms, catalogue in
   ``metrics_manifest.json``): byte-stable ``metrics_snapshot`` events
   at phase boundaries, an atomic Prometheus textfile, and the feed of
-  the cross-run fleet index (``tools/pert_fleet.py``).
+  the cross-run fleet index (``tools/pert_fleet.py``);
+* :mod:`~scdna_replication_tools_tpu.obs.spans` — causal span tracing
+  (schema v8): deterministic trace/span ids over the RunLog stream
+  (name catalogue in ``span_registry.json``), phases and fit chunks as
+  spans, cross-process stitching via ticket-borne trace ids, exported
+  as Perfetto timelines by ``tools/pert_trace.py``.
 
 See OBSERVABILITY.md at the repo root for the event reference and how
 the JSONL relates to PhaseTimer and ``tools/trace_summary.py``.
@@ -61,6 +66,13 @@ from scdna_replication_tools_tpu.obs.runlog import (  # noqa: F401
 from scdna_replication_tools_tpu.obs.schema import (  # noqa: F401
     validate_event,
     validate_run,
+)
+from scdna_replication_tools_tpu.obs.spans import (  # noqa: F401
+    SpanTracer,
+    attach_tracer,
+    derive_trace_id,
+    registry_span_names,
+    tracer_for_run,
 )
 from scdna_replication_tools_tpu.obs.summary import (  # noqa: F401
     read_events,

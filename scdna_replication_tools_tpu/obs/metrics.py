@@ -401,6 +401,11 @@ class MetricsRegistry:
             if payload.get("queue_depth") is not None:
                 self.gauge("pert_serve_queue_depth").set(
                     int(payload["queue_depth"]))
+            if payload.get("queue_wait_seconds") is not None:
+                # the queue-crossing span's duration (ticket commit ->
+                # claim) as a first-class latency component
+                self.observe("pert_serve_queue_wait_seconds",
+                             float(payload["queue_wait_seconds"]))
             if payload.get("pad_frac") is not None \
                     and payload.get("bucket"):
                 self.gauge("pert_serve_bucket_pad_frac",

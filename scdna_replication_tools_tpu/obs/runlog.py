@@ -38,7 +38,14 @@ from scdna_replication_tools_tpu.obs import metrics as _metrics
 from scdna_replication_tools_tpu.utils import profiling
 from scdna_replication_tools_tpu.utils.profiling import logger
 
-SCHEMA_VERSION = 7  # v7: the serving worker's request lifecycle —
+SCHEMA_VERSION = 8  # v8: causal span tracing (obs/spans.py) — the
+# `span_end` event (one per closed span: trace_id/span_id/parent_id,
+# wall start + duration, typed attrs, process_index) plus the optional
+# `span` envelope on every other event and `trace_id` on run_start.
+# ALL of it is emitted only when a tracer is attached
+# (PertConfig.trace_spans / the serve worker), so tracing-off runs
+# produce streams with no v8-specific bytes and pre-v8 consumers stay
+# valid; v7: the serving worker's request lifecycle —
 # `request_start`/`request_end` events (tools/pert_serve.py worker,
 # serve/worker.py) plus the optional `request_id` field on run_start
 # (per-request RunLogs written under the worker's results tree carry
@@ -149,9 +156,13 @@ def _config_digest(config) -> Optional[str]:
     ``request_id`` is excluded for the same reason in serving terms:
     it is pure per-request identity (the fleet index groups serve
     traffic by it separately, via ``--request``) and folding it in
-    would make every request hash distinct by construction.  Fields
-    that change behaviour (compile_cache_dir, checkpoint_dir,
-    iteration budgets, ...) stay in.
+    would make every request hash distinct by construction.
+    ``trace_spans``/``trace_parent`` are excluded for both reasons at
+    once: tracing is pure observability (a traced/untraced pair of the
+    same workload must hash equal) and the trace-parent handoff is
+    per-request identity.  Fields that change behaviour
+    (compile_cache_dir, checkpoint_dir, iteration budgets, ...) stay
+    in.
     """
     try:
         if dataclasses.is_dataclass(config):
@@ -159,7 +170,8 @@ def _config_digest(config) -> Optional[str]:
         if isinstance(config, dict):
             config = {k: v for k, v in config.items()
                       if k not in ("telemetry_path", "metrics_textfile",
-                                   "request_id")}
+                                   "request_id", "trace_spans",
+                                   "trace_parent")}
         blob = json.dumps(config, sort_keys=True, default=_json_safe)
         return hashlib.sha256(blob.encode()).hexdigest()[:12]
     except (TypeError, ValueError):
@@ -259,6 +271,15 @@ class RunLog:
         # logs (bench runs, tests) — a stale process-global registry
         # must never inject snapshot events into an unrelated stream
         self.metrics_registry = None
+        # the span tracer riding this log (obs/spans.attach_tracer):
+        # None — the default — keeps the stream byte-for-byte free of
+        # span material (no envelope, no span_end, no trace_id), which
+        # is the schema-v8 gating contract
+        self.tracer = None
+        # the root 'run' span the session opens when a tracer is
+        # attached (closed just before run_end so its span_end rides
+        # inside the stream)
+        self._root_span = None
 
     @classmethod
     def create(cls, telemetry_path, run_name: str = "pert") -> "RunLog":
@@ -340,8 +361,19 @@ class RunLog:
                 payload["config"] = dataclasses.asdict(config)
             elif isinstance(config, dict):
                 payload["config"] = config
+        if self.tracer is not None:
+            # the stitching key: tools/pert_trace groups logs of one
+            # causal story (a serve request's worker + request logs,
+            # a multi-host run's per-process logs) by this id
+            payload.setdefault("trace_id", self.tracer.trace_id)
         self._pending_context = {}
         self.emit("run_start", **payload)
+        if self.tracer is not None:
+            # the root span of the run: every phase/chunk/request span
+            # parents under it (or under a cross-process trace_parent
+            # the tracer carries); closed by close_run just before
+            # run_end so its span_end rides inside the stream
+            self._root_span = self.tracer.begin("run", run_name=run_name)
 
     def close_run(self, status: str = "ok", error=None,
                   phases: Optional[dict] = None) -> None:
@@ -349,6 +381,13 @@ class RunLog:
         # still needs its session state reset and its handle closed
         if not self._open:
             return
+        if self.tracer is not None and self._root_span is not None:
+            # close the run span (and any stragglers under it) FIRST:
+            # the span_end events must land inside the stream, and
+            # run_end itself must not carry a reference to a span that
+            # is about to close
+            self.tracer.end(self._root_span, status=status)
+            self._root_span = None
         # the GUARANTEED final metrics snapshot: close_run is reached on
         # every session exit (including the exception path), so a run
         # whose log owns a metrics registry always closes with one
@@ -458,6 +497,18 @@ class RunLog:
             return
         record = {"event": event, "seq": self._seq,
                   "t": round(self._elapsed(), 4), **payload}
+        # the span envelope (schema v8): every event emitted while a
+        # span is open carries the causal context it happened under —
+        # ONLY when a tracer is attached (tracing-off streams carry no
+        # span bytes), and not on span_end itself (it carries its own
+        # ids at the top level)
+        if self.tracer is not None and event != "span_end" \
+                and "span" not in record:
+            cur = self.tracer.current()
+            if cur is not None:
+                record["span"] = {"trace_id": cur.trace_id,
+                                  "span_id": cur.span_id,
+                                  "parent_id": cur.parent_id}
         self._seq += 1
         try:
             if self._fh is None:

@@ -191,6 +191,30 @@ def summarize_events(events: List[dict]) -> dict:
         if peak is not None:
             hbm_by_phase[str(ev.get("phase"))] = peak
 
+    # causal spans (schema v8): per-name rollup of every span_end — the
+    # "where the time went" table — plus the raw span list for timeline
+    # consumers (tools/pert_trace.py exports from the events directly;
+    # the summary keeps the rollup so reports need no second parse).
+    # Both empty on pre-v8 / tracing-off logs.
+    span_events = _of(events, "span_end")
+    spans_by_name: dict = {}
+    for ev in span_events:
+        name = str(ev.get("name"))
+        slot = spans_by_name.setdefault(name,
+                                        {"count": 0, "seconds": 0.0})
+        slot["count"] += 1
+        slot["seconds"] = round(
+            slot["seconds"] + float(ev.get("duration_seconds") or 0.0), 6)
+    trace_ids = sorted({str(ev.get("trace_id")) for ev in span_events
+                        if ev.get("trace_id")})
+
+    # queue-wait (the queue-crossing span, surfaced on request_start):
+    # joined onto the request_end rows below by request id
+    queue_wait_by_request = {
+        ev.get("request_id"): ev.get("queue_wait_seconds")
+        for ev in _of(events, "request_start")
+        if ev.get("queue_wait_seconds") is not None}
+
     return {
         "run_name": start.get("run_name"),
         # serve traffic (schema v7): per-request RunLogs carry the
@@ -250,10 +274,20 @@ def summarize_events(events: List[dict]) -> dict:
             "request_id": ev.get("request_id"),
             "status": ev.get("status"),
             "wall_seconds": ev.get("wall_seconds"),
+            "queue_wait_seconds":
+                queue_wait_by_request.get(ev.get("request_id")),
             "bucket": ev.get("bucket"),
             "compile_cache": ev.get("compile_cache"),
             "error_class": ev.get("error_class"),
         } for ev in _of(events, "request_end")],
+        # causal spans (schema v8, tracing-on runs only): rollup by
+        # span name + the trace ids present; empty otherwise
+        "spans": {
+            "count": len(span_events),
+            "by_name": spans_by_name,
+            "trace_ids": trace_ids,
+        },
+        "trace_id": start.get("trace_id"),
         "rescues": _of(events, "rescue"),
         "nan_aborts": _of(events, "nan_abort"),
         "checkpoints": _of(events, "checkpoint"),

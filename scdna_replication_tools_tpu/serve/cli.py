@@ -16,6 +16,11 @@ shim for checkouts driven without an install).
     pert-serve status --spool /data/pert_spool <request_id>
     pert-serve collect --spool /data/pert_spool <request_id>
 
+    # no request id: the LIVE worker surface (status.json heartbeat —
+    # in-flight request + open span stack, queue depth, bucket
+    # ledger, recent outcomes) plus the queue listing
+    pert-serve status --spool /data/pert_spool
+
 See serve/__init__.py for the architecture, README "Serving" for the
 quickstart, and OBSERVABILITY.md for the request_start/request_end
 events + worker gauges.  ``bench.py --serve-ab`` measures the warm
@@ -97,6 +102,15 @@ def main(argv=None) -> int:
                           help="default scRT option applied to every "
                                "request (tickets override per "
                                "request); repeatable")
+    p_worker.add_argument("--trace-spans", default=True,
+                          action=argparse.BooleanOptionalAction,
+                          help="causal span tracing per request "
+                               "(default ON): queue-wait/admission/"
+                               "fit/stream-back spans in the worker "
+                               "log + the request log, stitched by the "
+                               "ticket's trace id — export a Perfetto "
+                               "timeline with tools/pert_trace.py; "
+                               "--no-trace-spans mutes it")
 
     p_submit = sub.add_parser(
         "submit", help="queue one request (returns the request id; "
@@ -143,7 +157,8 @@ def main(argv=None) -> int:
             poll_interval=args.poll_interval,
             max_requests=args.max_requests,
             exit_when_idle=args.exit_when_idle,
-            default_options=_parse_option(args.option))
+            default_options=_parse_option(args.option),
+            trace_spans=args.trace_spans)
         stats = worker.run()
         _emit(json.dumps(stats, indent=1))
         return 0
@@ -164,7 +179,28 @@ def main(argv=None) -> int:
                 return 1
             _emit(json.dumps(doc, indent=1))
         else:
-            _emit(json.dumps(queue.list_requests(), indent=1))
+            # the live worker surface: status.json (atomic heartbeat —
+            # in-flight request + its open span stack, queue depth,
+            # bucket-residency ledger, recent outcomes) plus the queue
+            # listing.  "what is the worker doing right now, and how
+            # long has it been stuck there" — worker.age_seconds and
+            # the per-span ages answer the second half
+            worker_doc = None
+            try:
+                worker_doc = json.loads(queue.status_path.read_text())
+                updated = worker_doc.get("updated_unix")
+                if isinstance(updated, (int, float)):
+                    import time as _time
+
+                    worker_doc["age_seconds"] = round(
+                        max(_time.time() - updated, 0.0), 3)
+            except (OSError, ValueError):
+                pass  # no worker has ever run on this spool (or the
+                # status surface is unreadable): worker=null says so
+            _emit(json.dumps({
+                "worker": worker_doc,
+                "requests": queue.list_requests(),
+            }, indent=1))
         return 0
 
     # collect

@@ -70,10 +70,21 @@ class RequestTicket:
     g1_path: str
     options: dict = dataclasses.field(default_factory=dict)
     submitted_unix: float = 0.0
+    # the causal trace id riding the ticket (obs/spans.py): submission
+    # derives it from the request id, the worker's request span and the
+    # per-request run's whole span tree carry it, and pert_trace
+    # stitches the worker + request logs into one timeline on it
+    trace_id: Optional[str] = None
     # terminal fields, filled by the worker's finish()
     status: Optional[str] = None          # ok / failed / refused
     error: Optional[str] = None
     results_dir: Optional[str] = None
+    # claim-side timestamps (worker-local, set by claim(), not part of
+    # the submitted ticket): the pending file's mtime — the atomic
+    # commit instant — and the claim instant.  Their difference IS the
+    # queue-wait span.
+    pending_mtime: Optional[float] = None
+    claimed_unix: Optional[float] = None
 
     def to_json(self) -> bytes:
         return (json.dumps(dataclasses.asdict(self), indent=1,
@@ -103,6 +114,14 @@ class SpoolQueue:
     def results_dir(self, request_id: str) -> pathlib.Path:
         return self.root / "results" / request_id
 
+    @property
+    def status_path(self) -> pathlib.Path:
+        """The worker's live status surface: ``status.json`` in the
+        spool root, rewritten atomically by the worker's heartbeat
+        (see ``serve/worker.py``) and rendered by ``pert-serve
+        status``."""
+        return self.root / "status.json"
+
     # -- submission -------------------------------------------------------
 
     def submit(self, s_path, g1_path, options: Optional[dict] = None,
@@ -116,10 +135,13 @@ class SpoolQueue:
                for s in _STATES):
             raise ValueError(f"request id {request_id!r} already exists "
                              f"in the spool {self.root}")
+        from scdna_replication_tools_tpu.obs.spans import derive_trace_id
+
         ticket = RequestTicket(
             request_id=request_id, s_path=str(s_path),
             g1_path=str(g1_path), options=dict(options or {}),
-            submitted_unix=round(time.time(), 3))
+            submitted_unix=round(time.time(), 3),
+            trace_id=derive_trace_id(request_id))
         atomic_write_bytes(self._ticket_path("pending", request_id),
                            ticket.to_json())
         return request_id
@@ -172,11 +194,22 @@ class SpoolQueue:
         for path in self.pending():
             target = self.root / "active" / path.name
             try:
+                # the pending file's mtime is the atomic-commit instant
+                # — the queue-wait span's start; read it BEFORE the
+                # rename (the rename preserves mtime, but a stat after
+                # a lost race would hit the wrong file)
+                mtime = path.stat().st_mtime
+            except OSError:
+                mtime = None
+            try:
                 os.rename(path, target)
             except OSError:
                 continue  # another worker won, or the ticket vanished
             try:
-                return RequestTicket.from_json(target.read_bytes())
+                ticket = RequestTicket.from_json(target.read_bytes())
+                ticket.pending_mtime = mtime
+                ticket.claimed_unix = round(time.time(), 6)
+                return ticket
             except (OSError, ValueError, TypeError) as exc:
                 # a malformed ticket must not wedge the queue: park it
                 # as failed with the parse error recorded

@@ -25,21 +25,39 @@ drains queued requests through it.  Each request:
    when step 3 runs), ``cell_qc.tsv``, the request RunLog, and a
    terminal ticket.
 
-The worker emits schema-v7 ``request_start``/``request_end`` events on
-its own RunLog and feeds the worker gauges (``pert_serve_queue_depth``,
-``pert_serve_requests_total``, ``pert_serve_bucket_pad_frac``) through
-the same emit seam; its Prometheus textfile
-(``--metrics-textfile``) is the scrape surface PR 9 built for exactly
-this resident process.  SIGTERM/SIGINT request a graceful drain: the
-in-flight request completes, pending tickets stay queued for the next
-worker, and the worker log closes cleanly.
+The worker emits ``request_start``/``request_end`` events on its own
+RunLog and feeds the worker gauges (``pert_serve_queue_depth``,
+``pert_serve_requests_total``, ``pert_serve_bucket_pad_frac``,
+``pert_serve_queue_wait_seconds``) through the same emit seam; its
+Prometheus textfile (``--metrics-textfile``) is the scrape surface
+PR 9 built for exactly this resident process.  SIGTERM/SIGINT request
+a graceful drain: the in-flight request completes, pending tickets
+stay queued for the next worker, and the worker log closes cleanly.
+
+Two live surfaces ride on top (schema v8, OBSERVABILITY.md
+"Tracing"):
+
+* **causal spans** (default ON): each request is one trace — the
+  ``request`` root span, the ``queue_wait`` spool crossing (ticket
+  commit → claim), ``admission``, ``stream_back``, and, via the
+  ``trace_parent`` handoff, the per-request run's entire span tree —
+  exportable as one stitched Perfetto timeline with
+  ``tools/pert_trace.py``;
+* **status.json** in the spool root: an atomically heartbeat-written
+  snapshot of the in-flight request + its open span stack, queue
+  depth, the bucket-residency ledger and recent outcomes — what
+  ``pert-serve status <spool>`` renders, the first way to ask a
+  running worker "what are you doing right now and how long has it
+  been stuck there".
 """
 
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import itertools
+import json
 import os
 import signal
 import threading
@@ -49,6 +67,7 @@ from typing import Optional
 import pandas as pd
 
 from scdna_replication_tools_tpu.obs import metrics as metrics_mod
+from scdna_replication_tools_tpu.obs import spans as spans_mod
 from scdna_replication_tools_tpu.obs.runlog import RunLog
 from scdna_replication_tools_tpu.obs.summary import summarize_run
 from scdna_replication_tools_tpu.serve.buckets import (
@@ -60,6 +79,7 @@ from scdna_replication_tools_tpu.serve.queue import (
     SpoolQueue,
 )
 from scdna_replication_tools_tpu.utils import faults as faults_mod
+from scdna_replication_tools_tpu.utils.fileio import atomic_write_bytes
 from scdna_replication_tools_tpu.utils.profiling import logger
 
 # The subset of scRT keyword arguments a request ticket may override.
@@ -109,13 +129,20 @@ class ServeWorker:
                  poll_interval: float = 0.5,
                  max_requests: Optional[int] = None,
                  exit_when_idle: bool = False,
-                 default_options: Optional[dict] = None):
+                 default_options: Optional[dict] = None,
+                 trace_spans: bool = True):
         self.queue = queue
         self.buckets = buckets or BucketSet()
         self.poll_interval = float(poll_interval)
         self.max_requests = max_requests
         self.exit_when_idle = bool(exit_when_idle)
         self.default_options = dict(default_options or {})
+        # causal span tracing (obs/spans.py) — default ON for the
+        # worker: serving is exactly where "where did the p99 go" needs
+        # queue-wait/admission/fit/stream-back decomposed, and each
+        # request's trace id rides its ticket so pert_trace stitches
+        # the worker log + the per-request run log into one timeline
+        self.trace_spans = bool(trace_spans)
         # fail FAST on bad worker defaults: they apply to every
         # request, and a reserved key (telemetry_path, checkpoint_dir,
         # pad_*, request_id — the per-request kwargs the worker itself
@@ -138,6 +165,19 @@ class ServeWorker:
         self.outcomes: collections.deque = collections.deque(
             maxlen=RECENT_OUTCOMES)
         self._status_counts: dict = {}
+        # the live status surface (status.json in the spool root): the
+        # in-flight request + its open span stack, queue depth, the
+        # bucket-residency ledger, and the recent-outcome window —
+        # rewritten atomically at every state change plus a periodic
+        # heartbeat, so `pert-serve status <spool>` can ask a running
+        # worker "what are you doing right now and for how long"
+        self._started_unix = round(time.time(), 3)
+        self._processed = 0
+        self._state = "starting"
+        self._inflight: Optional[dict] = None
+        self._request_tracer: Optional[spans_mod.SpanTracer] = None
+        self._bucket_ledger: dict = {}
+        self._heartbeat_stop = threading.Event()
         queue.ensure_dirs()
         if telemetry_path is None:
             # pid + counter in the default name: multiple workers may
@@ -190,7 +230,6 @@ class ServeWorker:
         """Drain the spool until stopped; returns the session stats."""
         if threading.current_thread() is threading.main_thread():
             self.install_signal_handlers()
-        processed = 0
         config = {
             "spool": str(self.queue.root),
             "buckets": self.buckets.describe(),
@@ -198,34 +237,130 @@ class ServeWorker:
             "max_requests": self.max_requests,
             "exit_when_idle": self.exit_when_idle,
             "default_options": self.default_options,
+            "trace_spans": self.trace_spans,
         }
-        with self.worker_log.session(config=config,
-                                     run_name="pert_serve"):
-            while not self._draining:
-                if self.max_requests is not None \
-                        and processed >= self.max_requests:
-                    break
-                ticket = self.queue.claim()
-                if ticket is None:
-                    if self.exit_when_idle:
+        heartbeat = threading.Thread(target=self._heartbeat_loop,
+                                     name="pert-serve-status",
+                                     daemon=True)
+        self._heartbeat_stop.clear()
+        heartbeat.start()
+        try:
+            with self.worker_log.session(config=config,
+                                         run_name="pert_serve"):
+                while not self._draining:
+                    if self.max_requests is not None \
+                            and self._processed >= self.max_requests:
                         break
-                    self._sleep_poll()
-                    continue
-                outcome = self.process_request(ticket)
-                self.outcomes.append(outcome)
-                self._status_counts[outcome.status] = \
-                    self._status_counts.get(outcome.status, 0) + 1
-                processed += 1
-                self.registry.write_textfile()
+                    self._set_state("idle")
+                    ticket = self.queue.claim()
+                    if ticket is None:
+                        if self.exit_when_idle:
+                            break
+                        self._sleep_poll()
+                        continue
+                    outcome = self.process_request(ticket)
+                    self.outcomes.append(outcome)
+                    self._status_counts[outcome.status] = \
+                        self._status_counts.get(outcome.status, 0) + 1
+                    self._processed += 1
+                    self.registry.write_textfile()
+                    self._write_status()
+        finally:
+            # join the heartbeat BEFORE writing the terminal state: a
+            # heartbeat mid-write when the stop flag lands would
+            # otherwise commit its stale 'idle'/'processing' doc AFTER
+            # the 'stopped' one, leaving a live-looking status.json
+            # for a worker that has exited
+            self._heartbeat_stop.set()
+            heartbeat.join(timeout=5)
+            self._set_state("stopped")
         self.registry.write_textfile()
         return {
-            "processed": processed,
+            "processed": self._processed,
             "by_status": dict(self._status_counts),
             "drained": self._draining,
             "pending_left": self.queue.depth(),
             "worker_log": self.worker_log.path,
+            "status_path": str(self.queue.status_path),
             "outcomes": [dataclasses.asdict(o) for o in self.outcomes],
         }
+
+    # -- the live status surface ------------------------------------------
+
+    def _set_state(self, state: str) -> None:
+        if state != self._state:
+            self._state = state
+            self._write_status()
+
+    def _heartbeat_loop(self) -> None:
+        """Periodic status.json refresh from a daemon thread: the
+        worker thread is busy inside a fit for most of a request's
+        life, and "how long has it been stuck there" needs a fresh
+        ``updated_unix`` (and span-stack ages) regardless."""
+        interval = min(max(self.poll_interval, 0.2), 2.0)
+        while not self._heartbeat_stop.wait(interval):
+            self._write_status()
+
+    def _status_doc(self) -> dict:
+        inflight = None
+        if self._inflight is not None:
+            inflight = dict(self._inflight)
+            inflight["age_seconds"] = round(
+                max(time.time() - inflight.get("started_unix", 0.0),
+                    0.0), 3)
+            tracer = self._request_tracer
+            if tracer is not None:
+                # the WORKER-side open spans (request, and admission/
+                # stream_back while they run) with per-span ages.  The
+                # pipeline's own phase/chunk spans live on the request
+                # run's tracer and close as they complete — the
+                # last_span note below is what moves during the fit
+                inflight["span_stack"] = tracer.stack()
+                inflight["trace_id"] = tracer.trace_id
+            last = spans_mod.last_closed_span()
+            if last is not None:
+                # mid-fit progress: fit/chunk spans close every chunk,
+                # so "last completed span + age" answers "how long has
+                # it been stuck" even while the worker thread is deep
+                # inside scrt.infer()
+                last["age_seconds"] = round(
+                    max(time.time() - last.get("end_unix", 0.0), 0.0),
+                    3)
+                inflight["last_span"] = last
+        return {
+            "kind": "pert_serve_status",
+            "pid": os.getpid(),
+            "started_unix": self._started_unix,
+            "updated_unix": round(time.time(), 3),
+            "state": "draining" if self._draining
+            and self._state not in ("stopped",) else self._state,
+            "queue_depth": self.queue.depth(),
+            "in_flight": inflight,
+            "processed": self._processed,
+            "by_status": dict(self._status_counts),
+            # bucket-residency ledger: which compiled shape families
+            # this worker is keeping warm, and how much traffic each
+            # has served — the eviction/right-sizing signal
+            "buckets_served": dict(self._bucket_ledger),
+            "recent": [dataclasses.asdict(o)
+                       for o in list(self.outcomes)[-10:]],
+            "worker_log": self.worker_log.path,
+        }
+
+    def _write_status(self) -> None:
+        """Atomic heartbeat write (mkstemp + fsync + os.replace via
+        ``atomic_write_bytes``): a concurrent ``pert-serve status``
+        reader can never observe a torn document.  Never raises —
+        the status surface must not take down the worker."""
+        try:
+            doc = self._status_doc()
+            atomic_write_bytes(
+                self.queue.status_path,
+                (json.dumps(doc, indent=1, sort_keys=True)
+                 + "\n").encode())
+        except Exception as exc:  # noqa: BLE001 — best-effort surface;
+            # the worker log remains the durable record
+            logger.debug("pert-serve: status.json write failed: %s", exc)
 
     # -- one request ------------------------------------------------------
 
@@ -261,29 +396,85 @@ class ServeWorker:
         depth = self.queue.depth()
         options = self._merged_options(ticket)
         bucket = None
+        # --- causal tracing: one trace per request, id from the ticket.
+        # The request span is the root the queue-wait/admission/
+        # stream-back spans (worker log) AND the per-request run's own
+        # span tree (request log, via trace_parent) stitch under.
+        tracer = req_span = None
+        if self.trace_spans:
+            tracer = spans_mod.SpanTracer(
+                trace_id=ticket.trace_id
+                or spans_mod.derive_trace_id(rid))
+            spans_mod.attach_tracer(self.worker_log, tracer)
+            req_span = tracer.begin("request", request_id=rid)
+            self._request_tracer = tracer
+        # queue-wait: ticket commit (pending/ mtime) -> claim.  A real
+        # span over an interval the worker never executed through —
+        # the spool crossing — recorded retroactively from the claim
+        # timestamps and surfaced on request_start so the
+        # pert_serve_queue_wait_seconds histogram fills from the emit
+        # seam.
+        queue_wait = None
+        q_start = ticket.pending_mtime or ticket.submitted_unix or None
+        if ticket.claimed_unix and q_start:
+            queue_wait = max(float(ticket.claimed_unix)
+                             - float(q_start), 0.0)
+            if tracer is not None:
+                tracer.record_span("queue_wait", float(q_start),
+                                   float(ticket.claimed_unix),
+                                   request_id=rid)
+        self._inflight = {"request_id": rid,
+                          "started_unix": round(time.time(), 3)}
+        self._set_state("processing")
         try:
-            df_s = pd.read_csv(ticket.s_path, sep="\t",
-                               dtype={"chr": str})
-            df_g1 = pd.read_csv(ticket.g1_path, sep="\t",
-                                dtype={"chr": str})
-            shape = self._probe_shape(df_s, df_g1, options)
-            bucket = self.buckets.select(
-                max(shape["num_cells_s"], shape["num_cells_g1"]),
-                shape["num_loci"])
-            pad_frac = bucket.pad_frac(
-                max(shape["num_cells_s"], shape["num_cells_g1"]),
-                shape["num_loci"])
+            return self._process_claimed(
+                ticket, rid, results_dir, t0, depth, options, bucket,
+                tracer, req_span, queue_wait)
+        finally:
+            self._inflight = None
+            if tracer is not None:
+                if req_span is not None:
+                    tracer.end(req_span)
+                spans_mod.attach_tracer(self.worker_log, None)
+                self._request_tracer = None
+
+    def _process_claimed(self, ticket, rid, results_dir, t0, depth,
+                         options, bucket, tracer, req_span,
+                         queue_wait) -> RequestOutcome:
+        admission_cm = tracer.span("admission", request_id=rid) \
+            if tracer is not None else contextlib.nullcontext()
+        try:
+            with admission_cm:
+                df_s = pd.read_csv(ticket.s_path, sep="\t",
+                                   dtype={"chr": str})
+                df_g1 = pd.read_csv(ticket.g1_path, sep="\t",
+                                    dtype={"chr": str})
+                shape = self._probe_shape(df_s, df_g1, options)
+                bucket = self.buckets.select(
+                    max(shape["num_cells_s"], shape["num_cells_g1"]),
+                    shape["num_loci"])
+                pad_frac = bucket.pad_frac(
+                    max(shape["num_cells_s"], shape["num_cells_g1"]),
+                    shape["num_loci"])
             self.worker_log.emit(
                 "request_start", request_id=rid,
                 bucket={"name": bucket.name, "cells": bucket.cells,
                         "loci": bucket.loci},
                 pad_frac=round(pad_frac, 6), queue_depth=depth,
+                queue_wait_seconds=(round(queue_wait, 6)
+                                    if queue_wait is not None else None),
                 shape=shape)
+            # bucket-residency ledger (status.json): admitted traffic
+            # per compiled shape family this worker keeps warm
+            self._bucket_ledger[bucket.name] = \
+                self._bucket_ledger.get(bucket.name, 0) + 1
         except BucketRefusal as exc:
             wall = time.perf_counter() - t0
             self.worker_log.emit(
                 "request_start", request_id=rid, bucket=None,
                 pad_frac=None, queue_depth=depth,
+                queue_wait_seconds=(round(queue_wait, 6)
+                                    if queue_wait is not None else None),
                 detail="refused at admission")
             self.worker_log.emit(
                 "request_end", request_id=rid, status="refused",
@@ -302,6 +493,8 @@ class ServeWorker:
             self.worker_log.emit(
                 "request_start", request_id=rid, bucket=None,
                 pad_frac=None, queue_depth=depth,
+                queue_wait_seconds=(round(queue_wait, 6)
+                                    if queue_wait is not None else None),
                 detail="failed at admission")
             self.worker_log.emit(
                 "request_end", request_id=rid, status="failed",
@@ -319,7 +512,8 @@ class ServeWorker:
         run_log_path = str(results_dir / "run.jsonl")
         try:
             self._run_pipeline(rid, df_s, df_g1, options, bucket,
-                               results_dir, run_log_path)
+                               results_dir, run_log_path,
+                               tracer=tracer, req_span=req_span)
         except Exception as exc:
             # PER-REQUEST FAULT ISOLATION: whatever escaped the
             # pipeline — an OOM past the degradation ladder, a NaN
@@ -385,9 +579,19 @@ class ServeWorker:
                             compile_cache=compile_cache)
 
     def _run_pipeline(self, rid: str, df_s, df_g1, options: dict,
-                      bucket, results_dir, run_log_path: str) -> None:
+                      bucket, results_dir, run_log_path: str,
+                      tracer=None, req_span=None) -> None:
         from scdna_replication_tools_tpu.api import scRT
 
+        trace_kwargs = {}
+        if tracer is not None and req_span is not None:
+            # the cross-process handoff: the request run's own span
+            # tree (its 'run' root, every phase and fit chunk) carries
+            # the ticket's trace id and parents under the worker's
+            # request span — pert_trace stitches the two logs on it
+            trace_kwargs = dict(
+                trace_spans=True,
+                trace_parent=tracer.trace_parent(req_span))
         scrt = scRT(
             df_s, df_g1,
             telemetry_path=run_log_path,
@@ -395,6 +599,7 @@ class ServeWorker:
             pad_cells_to=bucket.cells,
             pad_loci_to=bucket.loci,
             request_id=rid,
+            **trace_kwargs,
             **options,
         )
         try:
@@ -403,17 +608,21 @@ class ServeWorker:
         except BaseException:
             self._cleanup_failed_request(scrt)
             raise
-        cn_s_out.to_csv(results_dir / "output.tsv", sep="\t",
-                        index=False)
-        supp_s.to_csv(results_dir / "supp.tsv", sep="\t", index=False)
-        if cn_g1_out is not None and len(cn_g1_out):
-            cn_g1_out.to_csv(results_dir / "g1_output.tsv", sep="\t",
-                             index=False)
-            supp_g1.to_csv(results_dir / "g1_supp.tsv", sep="\t",
-                           index=False)
-        if scrt._cell_qc_df is not None:
-            scrt.cell_qc().to_csv(results_dir / "cell_qc.tsv",
-                                  sep="\t", index=False)
+        stream_cm = tracer.span("stream_back", request_id=rid) \
+            if tracer is not None else contextlib.nullcontext()
+        with stream_cm:
+            cn_s_out.to_csv(results_dir / "output.tsv", sep="\t",
+                            index=False)
+            supp_s.to_csv(results_dir / "supp.tsv", sep="\t",
+                          index=False)
+            if cn_g1_out is not None and len(cn_g1_out):
+                cn_g1_out.to_csv(results_dir / "g1_output.tsv",
+                                 sep="\t", index=False)
+                supp_g1.to_csv(results_dir / "g1_supp.tsv", sep="\t",
+                               index=False)
+            if scrt._cell_qc_df is not None:
+                scrt.cell_qc().to_csv(results_dir / "cell_qc.tsv",
+                                      sep="\t", index=False)
 
     def _cleanup_failed_request(self, scrt) -> None:
         """A failed request must not leak process-global state into its

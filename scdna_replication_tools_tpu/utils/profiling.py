@@ -46,6 +46,11 @@ class PhaseTimer:
     ``on_add`` (optional callable ``(name, seconds)``) observes every
     accumulation — the seam the telemetry RunLog uses to stream ``phase``
     events (see ``obs/runlog.py``) without the timer depending on it.
+    Sinks CHAIN: the metrics registry (``obs.metrics.attach_phase_sink``)
+    and the span tracer (``obs.spans.attach_phase_sink`` — every phase
+    becomes a completed span) each wrap whatever was installed before
+    them, so one timer feeds the phase ledger, the metrics counters and
+    the span timeline from a single accumulation.
     """
 
     def __init__(self):

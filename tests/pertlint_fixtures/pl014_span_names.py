@@ -1,0 +1,58 @@
+"""Fixture for PL014 (span-hygiene).
+
+Parsed by the lint tests, never imported.  Lines ending in the expect
+marker must fire; the inline-disable line must land in the suppressed
+list.  Known names come from the REAL checked-in registry
+(obs/span_registry.json) — 'request', 'queue_wait', 'fit/chunk' are in
+it; 'totally_adhoc_span' is not.
+"""
+
+
+def known_names_are_clean(tracer):
+    with tracer.span("request"):          # in the registry, with'd
+        tracer.record_span("queue_wait", 0.0, 1.0)   # in the registry
+    span = tracer.begin("fit/chunk")      # begin/end: non-lexical ok
+    tracer.end(span)
+
+
+def unknown_name_fires(tracer):
+    with tracer.span("totally_adhoc_span"):  # expect: PL014
+        pass
+    with tracer.span("made_up_too"):  # pertlint: disable=PL014
+        pass
+
+
+def dropped_span_fires(tracer):
+    tracer.span("request")  # expect: PL014
+
+
+def never_withed_assignment_fires(tracer):
+    cm = tracer.span("request")  # expect: PL014
+    return cm is not None
+
+
+def conditional_cm_then_with_is_clean(tracer, null_cm):
+    cm = tracer.span("admission") if tracer is not None else null_cm
+    with cm:
+        pass
+
+
+def self_receiver_in_tracer_class_fires():
+    class FakeSpanTracer:
+        def span(self, name):
+            return self
+
+        def helper(self):
+            self.span("bogus_internal_span")  # expect: PL014
+
+
+def dynamic_name_is_exempt(tracer, name):
+    # non-literal: cannot be checked statically
+    with tracer.span(name):
+        pass
+
+
+def non_tracer_receivers_are_exempt(row, soup):
+    # .span on other APIs is a different vocabulary (HTML, layout, ...)
+    row.span("two-columns")
+    soup.span("highlight")

@@ -47,6 +47,7 @@ FIXTURES = {
     "PL011": FIXTURE_DIR / "pl011_swallowed.py",
     "PL012": FIXTURE_DIR / "pl012_metric_names.py",
     "PL013": FIXTURE_DIR / "pl013_raw_writes.py",
+    "PL014": FIXTURE_DIR / "pl014_span_names.py",
 }
 
 
@@ -200,6 +201,8 @@ def _seed_violation(rule_id):
                   "    metrics.counter('pert_bogus_total').inc()\n"),
         "PL013": ("\ndef seeded(path, arr):\n"
                   "    np.savez(path, arr=arr)\n"),
+        "PL014": ("\ndef seeded(tracer):\n"
+                  "    tracer.span('request')\n"),
     }[rule_id]
 
 

@@ -10,8 +10,10 @@ tool closes that gap:
     python -m tools.pert_fleet query   [--config-hash H] [--run-name N]
                                        [--status S] [--request ID|*]
                                        [--since D] [--until D]
+                                       [--format markdown|json]
     python -m tools.pert_fleet trend   [--metric M ...] [--request ID|*]
                                        [--out FILE]
+                                       [--format markdown|json]
     python -m tools.pert_fleet regress --baseline FILE [--run LOG]
                                        [--tolerance-scale S]
                                        [--write-baseline FILE]
@@ -270,6 +272,32 @@ def default_trend_metrics() -> List[str]:
             if spec.get("regress")]
 
 
+def trend_document(runs: List[dict], metric_names: List[str]) -> dict:
+    """Machine-readable twin of :func:`render_trend` (``trend --format
+    json``): per metric, the manifest spec plus the time-ordered value
+    series — the interface the cross-run autopilot (ROADMAP item 5)
+    consumes instead of re-parsing markdown."""
+    known = manifest_metrics()
+    metrics: dict = {}
+    for name in metric_names:
+        values = [(r.get("metrics") or {}).get(name) for r in runs]
+        if not any(isinstance(v, (int, float)) for v in values):
+            continue
+        spec = known.get(name, {})
+        metrics[name] = {
+            "help": spec.get("help"),
+            "regress": spec.get("regress"),
+            "values": values,
+            "runs": [{"file": r.get("file"),
+                      "when_unix": _run_time(r) or None,
+                      "config_hash": r.get("config_hash"),
+                      "value": v}
+                     for r, v in zip(runs, values)],
+        }
+    return {"kind": "pert_fleet_trend", "num_runs": len(runs),
+            "metrics": metrics}
+
+
 def render_trend(runs: List[dict], metric_names: List[str]) -> str:
     lines = [f"# PERT fleet trend — {len(runs)} run(s)", ""]
     if not runs:
@@ -471,8 +499,14 @@ def main(argv=None) -> int:
                               "pert-serve spool/results tree)")
     p_query.add_argument("--since", default=None, metavar="YYYY-MM-DD")
     p_query.add_argument("--until", default=None, metavar="YYYY-MM-DD")
+    p_query.add_argument("--format", default="markdown",
+                         choices=("markdown", "json"),
+                         help="output format: the markdown table "
+                              "(default) or the matching records as "
+                              "JSON (machine-readable; the autopilot/"
+                              "scripting interface)")
     p_query.add_argument("--json", action="store_true",
-                         help="emit the matching records as JSON")
+                         help="alias for --format json")
 
     p_trend = sub.add_parser("trend", help="markdown table + sparkline "
                                            "per metric across runs")
@@ -490,8 +524,15 @@ def main(argv=None) -> int:
                          help="metric names/series keys to trend "
                               "(default: every manifest metric with a "
                               "regress gate)")
+    p_trend.add_argument("--format", default="markdown",
+                         choices=("markdown", "json"),
+                         help="output format: markdown + sparklines "
+                              "(default) or a JSON document of "
+                              "per-metric value series (machine-"
+                              "readable; the autopilot/scripting "
+                              "interface)")
     p_trend.add_argument("--out", default=None,
-                         help="write the markdown here instead of stdout")
+                         help="write the report here instead of stdout")
 
     p_reg = sub.add_parser(
         "regress",
@@ -528,7 +569,7 @@ def main(argv=None) -> int:
 
     if args.cmd == "query":
         runs = filter_runs(load_runs(args), args)
-        if args.json:
+        if args.json or args.format == "json":
             print(json.dumps(runs, indent=1))
         else:
             print(render_query(runs))
@@ -537,7 +578,10 @@ def main(argv=None) -> int:
     if args.cmd == "trend":
         runs = filter_runs(load_runs(args), args)
         metrics = args.metric or default_trend_metrics()
-        report = render_trend(runs, metrics)
+        if args.format == "json":
+            report = json.dumps(trend_document(runs, metrics), indent=1)
+        else:
+            report = render_trend(runs, metrics)
         if args.out:
             pathlib.Path(args.out).write_text(report + "\n")
         else:

@@ -303,6 +303,63 @@ def _resilience_section(res: dict, schema_version) -> list:
     return lines
 
 
+def _spans_section(summary: dict) -> list:
+    """"Where the time went" (schema v8 ``span_end`` events): the span
+    rollup as a component waterfall — queue-wait / admission / pad /
+    compile / fit / decode / stream-back — plus the raw per-name
+    table, and a per-request latency table on serve worker logs.
+    Placeholder on tracing-off / pre-v8 logs."""
+    lines = ["## Where the time went (spans)", ""]
+    spans = summary.get("spans") or {}
+    by_name = spans.get("by_name") or {}
+    if not by_name:
+        version = summary.get("schema_version")
+        if version is not None and version < 8:
+            return lines + ["_pre-v8 run log: no span events in this "
+                            "schema version_", ""]
+        return lines + ["_no span_end events (tracing off — enable "
+                        "with --trace-spans / PertConfig.trace_spans; "
+                        "the serve worker traces by default)_", ""]
+    from tools.pert_trace import WATERFALL_COMPONENTS, classify_span
+
+    components = {c: 0.0 for c in WATERFALL_COMPONENTS}
+    for name, slot in by_name.items():
+        comp = classify_span(name)
+        if comp is not None:
+            components[comp] += float(slot.get("seconds") or 0.0)
+    total = sum(components.values()) or 1.0
+    lines += ["| component | seconds | share | |",
+              "|---|---:|---:|---|"]
+    for comp in WATERFALL_COMPONENTS:
+        secs = components[comp]
+        if secs == 0.0:
+            continue
+        share = secs / total
+        bar = "#" * round(share * _BAR_WIDTH)
+        lines.append(f"| {comp} | {secs:.2f} | {share:.1%} | `{bar}` |")
+    lines.append(f"| **total (leaf spans)** | **{total:.2f}** | | |")
+    lines += ["", "| span | count | seconds |", "|---|---:|---:|"]
+    for name, slot in sorted(by_name.items(),
+                             key=lambda kv: -kv[1]["seconds"]):
+        lines.append(f"| `{name}` | {slot['count']} "
+                     f"| {slot['seconds']:.2f} |")
+    requests = summary.get("requests") or []
+    if requests:
+        lines += ["", "per-request latency (serve mode; pad/compile/"
+                      "fit/decode live in each request's own run log — "
+                      "`python -m tools.pert_trace waterfall`):", "",
+                  "| request | status | queue wait | wall |",
+                  "|---|---|---:|---:|"]
+        for req in requests:
+            qw = req.get("queue_wait_seconds")
+            lines.append(
+                f"| {req.get('request_id')} | {req.get('status')} "
+                f"| {'-' if qw is None else f'{qw:.2f}s'} "
+                f"| {_fmt_seconds(req.get('wall_seconds'))} |")
+    lines.append("")
+    return lines
+
+
 def _fmt_metric_value(entry: dict) -> str:
     if entry.get("type") == "histogram":
         return (f"count={entry.get('count')} sum={entry.get('sum')} "
@@ -387,6 +444,7 @@ def render_report(path) -> str:
         raise SystemExit(f"pert_report: no readable events in {path}")
     lines = _header(summary)
     lines += _phase_waterfall(summary["phases"])
+    lines += _spans_section(summary)
     lines += _fit_table(summary["fits"])
     lines += _model_health_section(summary.get("fit_health", []),
                                    summary.get("cell_qc", []))

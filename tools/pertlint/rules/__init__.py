@@ -12,6 +12,7 @@ from tools.pertlint.rules import (  # noqa: F401
     print_log,
     raw_writes,
     rng,
+    span_names,
     swallowed,
     tracer_branch,
 )

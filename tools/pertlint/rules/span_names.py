@@ -1,0 +1,192 @@
+"""PL014: span hygiene — registered names at tracer call sites, and
+``span()`` used as a context manager.
+
+The span vocabulary is the checked-in registry
+(``scdna_replication_tools_tpu/obs/span_registry.json``), the same
+discipline PL009/PL010/PL012 apply to event kinds, controller actions
+and metric names: a literal span name opened at a
+``tracer.span(...)`` / ``tracer.begin(...)`` /
+``tracer.record_span(...)`` call site that the registry does not know
+produces trace rows no timeline/waterfall consumer can join on —
+discoverable only by staring at a Perfetto dump three rounds later.
+Dynamic names are exempt (PhaseTimer-derived spans carry the phase
+name itself; that vocabulary is owned by the phase ledger).
+
+The second check is structural: ``tracer.span(...)`` returns a context
+manager, and a span that is never closed wedges the open-span stack —
+every later span parents under it and the worker's status surface
+reports a forever-"in flight" phase.  The rule flags:
+
+* a bare ``tracer.span(...)`` expression statement (created and
+  dropped: the span can never close);
+* ``name = tracer.span(...)`` where ``name`` is never used as a
+  ``with`` context in the same function.
+
+Code that genuinely needs a non-lexical lifetime uses the explicit
+``begin()``/``end()`` pair — that is what the API split exists for.
+
+Precision contract: only receivers that look like a tracer fire — a
+name/attribute containing ``tracer``, or ``self`` inside a ``*Tracer*``
+class — so unrelated ``.span``/``.begin`` APIs never match.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import json
+import pathlib
+from typing import FrozenSet, Iterable, Optional
+
+from tools.pertlint.core import Finding, Rule, register
+
+_REGISTRY_PATH = (pathlib.Path(__file__).resolve().parents[3]
+                  / "scdna_replication_tools_tpu" / "obs"
+                  / "span_registry.json")
+
+_RECEIVER_HINT = "tracer"
+_NAME_METHODS = ("span", "begin", "record_span")
+
+
+@functools.lru_cache(maxsize=1)
+def registry_span_names() -> FrozenSet[str]:
+    """Span names pinned by the checked-in registry; empty when the
+    file is unreadable (the rule then stays silent — a missing registry
+    is the span tests' problem, not a lint crash)."""
+    try:
+        doc = json.loads(_REGISTRY_PATH.read_text())
+        return frozenset(doc["spans"])
+    except (OSError, KeyError, TypeError, ValueError):
+        return frozenset()
+
+
+def _enclosing_tracer_class(node, ctx) -> bool:
+    cursor = ctx.parents.get(node)
+    while cursor is not None:
+        if isinstance(cursor, ast.ClassDef) and "Tracer" in cursor.name:
+            return True
+        cursor = ctx.parents.get(cursor)
+    return False
+
+
+def _is_tracer_receiver(value, node, ctx) -> bool:
+    if isinstance(value, ast.Name):
+        if value.id == "self":
+            return _enclosing_tracer_class(node, ctx)
+        return _RECEIVER_HINT in value.id.lower()
+    if isinstance(value, ast.Attribute):
+        return _RECEIVER_HINT in value.attr.lower()
+    return False
+
+
+def _literal_name(node: ast.Call) -> Optional[str]:
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        return node.args[0].value
+    return None
+
+
+def _stmt_context(node, ctx):
+    """(nearest statement ancestor, True when the call sits inside a
+    ``with`` item on the way up)."""
+    cursor = ctx.parents.get(node)
+    in_withitem = False
+    while cursor is not None and not isinstance(cursor, ast.stmt):
+        if isinstance(cursor, ast.withitem):
+            in_withitem = True
+        cursor = ctx.parents.get(cursor)
+    return cursor, in_withitem
+
+
+def _assign_names(stmt) -> list:
+    """Plain-name targets of an assignment statement ([] otherwise)."""
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        targets = [stmt.target]
+    return [t.id for t in targets if isinstance(t, ast.Name)]
+
+
+def _with_context_names(func) -> FrozenSet[str]:
+    """Names used as a ``with`` context expression anywhere in the
+    function body (nested functions included — a closure managing the
+    span is still a managed span)."""
+    names = set()
+    for node in ast.walk(func):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Name):
+                    names.add(expr.id)
+    return frozenset(names)
+
+
+@register
+class SpanHygiene(Rule):
+    id = "PL014"
+    name = "span-hygiene"
+    severity = "error"
+    description = ("tracer span call sites: literal span names must "
+                   "exist in obs/span_registry.json, and span() — a "
+                   "context manager — must actually be entered (a "
+                   "dropped or never-with'd span wedges the open-span "
+                   "stack; use begin()/end() for non-lexical "
+                   "lifetimes)")
+
+    def __init__(self, names: Optional[Iterable[str]] = None):
+        # injectable for tests; default = the checked-in registry
+        self._names = (registry_span_names() if names is None
+                       else frozenset(names))
+
+    def check(self, ctx) -> Iterable[Finding]:
+        # pass 1 — registered names at every tracer call site
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _NAME_METHODS):
+                continue
+            if not _is_tracer_receiver(node.func.value, node, ctx):
+                continue
+            name = _literal_name(node)
+            if name is not None and self._names \
+                    and name not in self._names:
+                yield self.finding(
+                    ctx, node,
+                    f"span name {name!r} is not in "
+                    f"obs/span_registry.json — register it (name + "
+                    f"help) so timeline/waterfall consumers can join "
+                    f"on it")
+        # pass 2 — unclosed spans, per function scope
+        for func in ast.walk(ctx.tree):
+            if not isinstance(func, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            with_names = _with_context_names(func)
+            for node in ast.walk(func):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "span"):
+                    continue
+                if not _is_tracer_receiver(node.func.value, node, ctx):
+                    continue
+                stmt, in_withitem = _stmt_context(node, ctx)
+                if in_withitem or stmt is None:
+                    continue
+                if isinstance(stmt, ast.Expr):
+                    yield self.finding(
+                        ctx, node,
+                        "span() created and dropped — the context "
+                        "manager is never entered, so the span never "
+                        "closes; wrap it in `with`, or use "
+                        "begin()/end() for a non-lexical lifetime")
+                    continue
+                assigned = _assign_names(stmt)
+                if assigned and not any(n in with_names
+                                        for n in assigned):
+                    yield self.finding(
+                        ctx, node,
+                        f"span() assigned to {assigned[0]!r} but never "
+                        f"used as a `with` context in this function — "
+                        f"the span never closes; enter it with "
+                        f"`with {assigned[0]}:`, or use begin()/end()")

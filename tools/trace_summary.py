@@ -40,8 +40,11 @@ _SKIP = ("wrapper", "np.asarray", "_value", "__int__",
 
 # a named_scope label as it appears embedded in XLA op names / trace
 # metadata: the scope prefix up to (not including) the next '/'.  Nested
-# scopes concatenate ("pert/decode/pert/qc_entropy/..."), so matching
-# code must take the LAST occurrence — the innermost scope.
+# scopes concatenate ("pert/decode/pert/qc_entropy/..."), and matching
+# code keys on the FULL scope path (every pert/* segment joined in
+# order): keying on the innermost leaf alone silently merged same-leaf
+# scopes under different parents (two pert/fetch regions inside
+# different decode scopes became one row).
 _SCOPE_RE = re.compile(r"pert/[A-Za-z0-9_.:-]+")
 
 
@@ -71,30 +74,36 @@ def _load_trace(path: str) -> dict:
 
 
 def _event_scope(event: dict):
-    """The ``pert/<phase>`` named_scope an event belongs to, or None.
+    """The FULL ``pert/*`` named-scope path an event belongs to, or
+    None.
 
     The scope string may land in the event name itself or in the args
     metadata (XLA attaches it as op metadata ``name``/``long_name``
-    depending on backend/version) — scan both.  When scopes nest
-    ("pert/decode/pert/qc_entropy/mul") the innermost — last — match
-    wins, so nested regions are not folded into their parent.
+    depending on backend/version) — scan both.  Nested scopes
+    ("pert/decode/pert/qc_entropy/mul") key as the whole path
+    ("pert/decode/pert/qc_entropy"): taking only the innermost leaf
+    merged same-leaf scopes under DIFFERENT parents into one row,
+    silently — the full path keeps them distinct while a reader can
+    still aggregate by suffix.
     """
     matches = _SCOPE_RE.findall(event.get("name", ""))
     if matches:
-        return matches[-1]
+        return "/".join(matches)
     args = event.get("args")
     if isinstance(args, dict):
         for value in args.values():
             if isinstance(value, str):
                 matches = _SCOPE_RE.findall(value)
                 if matches:
-                    return matches[-1]
+                    return "/".join(matches)
     return None
 
 
 def scope_totals(profile_dir: str) -> dict:
-    """Total device time per ``pert/*`` named scope, in SECONDS, summed
-    across every trace dump (gz or plain) under ``profile_dir``.
+    """Total device time per FULL ``pert/*`` named-scope path, in
+    SECONDS, summed across every trace dump (gz or plain) under
+    ``profile_dir``.  Keys are the whole scope path (nested scopes stay
+    distinct under different parents — see :func:`_event_scope`).
 
     The machine-readable twin of the report's "named_scope groups"
     section — ``scdna_replication_tools_tpu.api`` feeds these into the
@@ -165,8 +174,27 @@ def main(argv=None):
     ap.add_argument("profile_dir")
     ap.add_argument("--top", type=int, default=12)
     ap.add_argument("--out", default=None)
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable scope totals "
+                         "(full scope path -> device seconds) instead "
+                         "of the text report — the scripting twin of "
+                         "scope_totals(), e.g. for pert_trace's "
+                         "counter track or an external dashboard")
     args = ap.parse_args(argv)
-    report = summarise(args.profile_dir, args.top)
+    if args.json:
+        if not _trace_files(args.profile_dir):
+            raise SystemExit(
+                f"trace_summary: no *.trace.json(.gz) traces under "
+                f"{args.profile_dir} — expected the jax.profiler "
+                f"layout; write traces with PertConfig(profile_dir=...)")
+        report = json.dumps({
+            "profile_dir": str(args.profile_dir),
+            "scope_seconds": {k: round(v, 6) for k, v in
+                              sorted(scope_totals(
+                                  args.profile_dir).items())},
+        }, indent=1)
+    else:
+        report = summarise(args.profile_dir, args.top)
     if args.out:
         with open(args.out, "w") as fh:
             fh.write(report + "\n")
