@@ -13,6 +13,29 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
+# PertConfig fields EXCLUDED from the config content hash
+# (obs.runlog._config_digest) — the single source of the
+# hash-exclusion contract, consumed by the digest, stamped into the
+# checkpoint manifest (``hash_excludes``), and certified by the
+# pertlint flow layer (FL003/FL004: an excluded field must never reach
+# program identity — static argnames, shapes/padding, dtypes — or two
+# configs that hash equal would compile different programs).
+#
+# A field belongs here ONLY if it is pure observability or pure
+# per-request identity: the hash answers "same experiment?", so a
+# cold/warm or A/B pair must hash equal when only the log/scrape
+# locations or the request/trace identity moved.  Fields that change
+# behaviour (iteration budgets, checkpoint_dir, compile_cache_dir,
+# padding, dtypes, ...) stay hashed.  Keep this a literal tuple of
+# field-name strings: the flow linter reads it statically.
+NON_HASH_FIELDS = (
+    "telemetry_path",       # where THIS run's RunLog lands
+    "metrics_textfile",     # where the Prometheus textfile lands
+    "request_id",           # per-request identity (serve fleet index)
+    "trace_spans",          # tracing on/off is pure observability
+    "trace_parent",         # per-request trace handoff
+)
+
 
 @dataclasses.dataclass(frozen=True)
 class ColumnConfig:

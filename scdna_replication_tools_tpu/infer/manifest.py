@@ -53,6 +53,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from scdna_replication_tools_tpu.config import NON_HASH_FIELDS
 from scdna_replication_tools_tpu.utils.fileio import (  # noqa: F401 —
     # re-export: checkpoint.py (and historical callers) import the
     # atomic-commit primitive from here; the one implementation now
@@ -279,6 +280,10 @@ class RunManifest:
             self.doc["steps"] = {}
         self.doc["manifest_version"] = MANIFEST_VERSION
         self.doc["config_hash"] = config_hash
+        # which fields the hash does NOT cover (config.NON_HASH_FIELDS):
+        # a future reader comparing hashes across code versions can tell
+        # whether the exclusion contract itself changed between runs
+        self.doc["hash_excludes"] = sorted(NON_HASH_FIELDS)
         self.doc["data_fingerprint"] = fingerprint
         if host_fingerprints is not None and len(host_fingerprints) > 1:
             self.doc["host_fingerprints"] = {

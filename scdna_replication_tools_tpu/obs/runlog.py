@@ -149,29 +149,25 @@ _RUN_COUNTER = itertools.count()
 def _config_digest(config) -> Optional[str]:
     """Short content hash of the config for run comparison.
 
-    ``telemetry_path`` and ``metrics_textfile`` are excluded: they name
-    where THIS run's observability lands (every run's differs), and the
-    hash's job is "same experiment?" — a cold/warm or A/B pair must
-    hash equal when only the log/scrape locations moved.
-    ``request_id`` is excluded for the same reason in serving terms:
-    it is pure per-request identity (the fleet index groups serve
-    traffic by it separately, via ``--request``) and folding it in
-    would make every request hash distinct by construction.
-    ``trace_spans``/``trace_parent`` are excluded for both reasons at
-    once: tracing is pure observability (a traced/untraced pair of the
-    same workload must hash equal) and the trace-parent handoff is
-    per-request identity.  Fields that change behaviour
-    (compile_cache_dir, checkpoint_dir, iteration budgets, ...) stay
-    in.
+    The excluded fields are ``config.NON_HASH_FIELDS`` — the declared
+    hash-exclusion contract (single-sourced there; the rationale per
+    field lives next to the constant).  In short: pure observability
+    (``telemetry_path``, ``metrics_textfile``, ``trace_spans``) and
+    pure per-request identity (``request_id``, ``trace_parent``) are
+    excluded — a cold/warm or A/B pair of the same workload must hash
+    equal when only the log locations or request identity moved.
+    Fields that change behaviour (compile_cache_dir, checkpoint_dir,
+    iteration budgets, ...) stay in.  The pertlint flow layer (FL003/
+    FL004) certifies that no excluded field reaches program identity.
     """
+    from scdna_replication_tools_tpu.config import NON_HASH_FIELDS
+
     try:
         if dataclasses.is_dataclass(config):
             config = dataclasses.asdict(config)
         if isinstance(config, dict):
             config = {k: v for k, v in config.items()
-                      if k not in ("telemetry_path", "metrics_textfile",
-                                   "request_id", "trace_spans",
-                                   "trace_parent")}
+                      if k not in NON_HASH_FIELDS}
         blob = json.dumps(config, sort_keys=True, default=_json_safe)
         return hashlib.sha256(blob.encode()).hexdigest()[:12]
     except (TypeError, ValueError):

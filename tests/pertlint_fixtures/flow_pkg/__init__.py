@@ -1,0 +1,8 @@
+"""Seeded-defect fixture package for the pertlint FLOW layer.
+
+Parsed by tools/pertlint/flow (pure stdlib ast), NEVER imported — the
+``import jax`` / ``multihost_utils`` lines are call-graph anchors, not
+runtime dependencies.  Each ``expect: FLnnn`` comment pins one seeded
+defect to its exact line; functions named ``*_ok`` are NEGATIVE cases
+the rules must leave clean.
+"""

@@ -1,0 +1,7 @@
+"""Fixture hash-exclusion contract.
+
+The flow engine reads ``<package>.config.NON_HASH_FIELDS`` statically
+(a literal tuple of strings), exactly as it does for the real package.
+"""
+
+NON_HASH_FIELDS = ("telemetry_path", "request_id")

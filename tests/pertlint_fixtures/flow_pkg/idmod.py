@@ -1,0 +1,40 @@
+"""Seeded program-identity defects: FL003 / FL004 / FL005."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("tag",))
+def _render(x, tag):  # expect: FL003
+    return x
+
+
+def leak_tag(cfg, x):
+    # the hash-EXCLUDED telemetry_path becomes a static argname: two
+    # configs that hash equal compile different programs
+    return _render(x, tag=cfg.telemetry_path)  # expect: FL003
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def _kernel(x, mode):  # expect: FL004
+    return x
+
+
+def run_kernel(x, mode):
+    # 'mode' is caller-supplied public API with no in-package binding
+    # and no default — the config hash under-determines the program
+    return _kernel(x, mode=mode)
+
+
+@functools.partial(jax.jit, static_argnames=("opts",))
+def _stepper(x, opts=None):
+    return x
+
+
+def bad_static_container(x):
+    return _stepper(x, opts=[1, 2, 3])  # expect: FL005
+
+
+def bad_dynamic_scalar():
+    return _stepper(0.5)  # expect: FL005

@@ -1,0 +1,113 @@
+"""Seeded SPMD-discipline defects: FL001 / FL002 / FL006."""
+
+import jax
+import numpy as np
+from jax.experimental import multihost_utils
+
+
+def process_rank_and_count():
+    return jax.process_index(), jax.process_count()
+
+
+# -- FL001: collective under rank-divergent control flow ------------------
+
+def leader_gated_sync(tag):
+    if jax.process_index() == 0:
+        multihost_utils.sync_global_devices(tag)  # expect: FL001
+
+
+def early_return_shadow(state):
+    # the shadow of a rank-guarded early return: rank != 0 never
+    # arrives at the barrier below
+    if jax.process_index() != 0:
+        return None
+    multihost_utils.sync_global_devices("save")  # expect: FL001
+    return state
+
+
+def verdict_gated_allgather(fingerprints):
+    # the PR-11 shape: a host-LOCAL verdict (derived from the rank)
+    # gates the allgather — ranks that disagree on the verdict hang
+    rank, nproc = process_rank_and_count()
+    local_ok = _local_verdict(fingerprints, rank)
+    if local_ok:
+        return multihost_utils.process_allgather(fingerprints)  # expect: FL001
+    return None
+
+
+def _local_verdict(fingerprints, rank):
+    return fingerprints[rank] is not None
+
+
+def rescue_in_except(x):
+    try:
+        return _compute(x)
+    except ValueError:
+        multihost_utils.sync_global_devices("rescue")  # expect: FL001
+        return None
+
+
+def _compute(x):
+    return x + 1
+
+
+def _barrier():
+    multihost_utils.sync_global_devices("checkpoint")
+
+
+def leader_only_barrier(x):
+    # interprocedural: _barrier REACHES a collective, so guarding the
+    # call is as divergent as guarding the primitive
+    if jax.process_index() == 0:
+        _barrier()  # expect: FL001
+    return x
+
+
+def count_guarded_sync_ok(tag):
+    # NEGATIVE: process_count() is SPMD-uniform — every rank takes the
+    # same branch, so the guarded collective is sound
+    if jax.process_count() > 1:
+        multihost_utils.sync_global_devices(tag)
+
+
+def suppressed_sync(tag):
+    # inline suppression must apply to flow findings unchanged
+    if jax.process_index() == 0:
+        multihost_utils.sync_global_devices(tag)  # pertlint: disable=FL001; raw expect: FL001
+
+
+# -- FL002: collective order divergence across branches -------------------
+
+def branch_order_divergence(flag, x):
+    if flag:  # expect: FL002
+        multihost_utils.sync_global_devices("phase")
+        multihost_utils.process_allgather(x)
+    else:
+        multihost_utils.process_allgather(x)
+        multihost_utils.sync_global_devices("phase")
+
+
+def count_branch_order_ok(x):
+    # NEGATIVE: the branch condition is the (uniform) process count —
+    # every rank agrees on the branch, ordering cannot cross-match
+    nproc = jax.process_count()
+    if nproc > 1:
+        multihost_utils.process_allgather(x)
+        multihost_utils.sync_global_devices("multi")
+    else:
+        multihost_utils.sync_global_devices("multi")
+
+
+# -- FL006: host fetch on a multi-process-reachable path ------------------
+
+def fetch_after_sync(x):
+    multihost_utils.sync_global_devices("gather")
+    return np.asarray(x)  # expect: FL006
+
+
+def fetch_single_world_ok(x):
+    # NEGATIVE: the fetch sits on a provably single-process branch
+    multihost_utils.sync_global_devices("gather")
+    if jax.process_count() <= 1:
+        return np.asarray(x)
+    return x

@@ -1,0 +1,265 @@
+"""pertlint-flow: the interprocedural SPMD/program-identity layer.
+
+Three strata, mirroring test_pertlint_deep:
+
+* fixture-unit — every FL rule catches its seeded defect in
+  tests/pertlint_fixtures/flow_pkg (including the PR-11 verdict-gated
+  allgather reconstruction), pinned line-exactly by ``expect:``
+  comments, and the negative (``*_ok``) cases stay clean;
+* contract — the identity map covers the deep registry exactly, the
+  committed ``artifacts/PROGRAM_IDENTITY.json`` is current,
+  schema-valid and fully hash-covered, and the NON_HASH_FIELDS
+  exclusion contract is single-sourced: statically readable by the
+  flow engine AND honoured by the run-log config digest;
+* the gate — ``python -m tools.pertlint --flow`` exits 0 on HEAD with
+  every baselined flow finding carrying a rationale.
+"""
+
+import dataclasses
+import json
+import pathlib
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO_ROOT))
+
+from tools.pertlint.deep import entrypoints  # noqa: E402
+from tools.pertlint.flow.engine import (  # noqa: E402
+    ENTRY_JIT,
+    _SYNTHETIC_ENTRIES,
+    build_flow_context,
+    flow_lint,
+    non_hash_fields_of,
+    run_flow_rules,
+)
+from tools.pertlint.flow.identity import SCHEMA  # noqa: E402
+
+BASELINE = REPO_ROOT / "tools" / "pertlint" / "baseline.json"
+ARTIFACT = REPO_ROOT / "artifacts" / "PROGRAM_IDENTITY.json"
+FIXTURE_PKG = REPO_ROOT / "tests" / "pertlint_fixtures" / "flow_pkg"
+
+_EXPECT = re.compile(r"expect:\s*((?:PL|DP|FL)\d{3})")
+
+
+def _expected_findings():
+    out = set()
+    for f in sorted(FIXTURE_PKG.glob("*.py")):
+        for i, line in enumerate(f.read_text().splitlines(), 1):
+            m = _EXPECT.search(line)
+            if m:
+                out.add((f.name, i, m.group(1)))
+    return out
+
+
+@pytest.fixture(scope="module")
+def fixture_run():
+    ctx = build_flow_context(package_root=FIXTURE_PKG,
+                             registry_names=None)
+    findings, stats = run_flow_rules(ctx=ctx)
+    return ctx, findings, stats
+
+
+@pytest.fixture(scope="module")
+def head_ctx():
+    return build_flow_context()
+
+
+# -- fixture-unit: one seeded defect per FL rule --------------------------
+
+def test_every_fl_rule_catches_its_seeded_defect(fixture_run):
+    """Line-exact: the findings are precisely the ``expect:`` set —
+    nothing missed (rules fire) and nothing extra (negatives clean)."""
+    _, findings, _ = fixture_run
+    got = {(pathlib.Path(f.path).name, f.line, f.rule) for f in findings}
+    expected = _expected_findings()
+    assert expected, "fixture package lost its expect comments"
+    assert {r for _, _, r in expected} == \
+        {"FL001", "FL002", "FL003", "FL004", "FL005", "FL006"}
+    missing = expected - got
+    unexpected = got - expected
+    assert not missing, f"rules failed to fire: {sorted(missing)}"
+    assert not unexpected, f"false positives: {sorted(unexpected)}"
+
+
+def test_pr11_verdict_gated_allgather_reconstruction(fixture_run):
+    """The PR-11 deadlock class specifically: an allgather gated on a
+    rank-derived local verdict is caught as FL001."""
+    _, findings, _ = fixture_run
+    hits = [f for f in findings if f.rule == "FL001"
+            and "verdict_gated_allgather" in f.message
+            and "process_allgather" in f.message]
+    assert len(hits) == 1, [f.message for f in findings
+                            if f.rule == "FL001"]
+
+
+def test_interprocedural_collective_closure(fixture_run):
+    """Guarding a CALL that reaches a collective is as divergent as
+    guarding the primitive — the closure, not just the roots."""
+    _, findings, _ = fixture_run
+    hits = [f for f in findings if f.rule == "FL001"
+            and "leader_only_barrier" in f.message]
+    assert len(hits) == 1
+
+
+def test_negative_cases_stay_clean(fixture_run):
+    """count-uniform guards and provably-single-process branches are
+    the soundness edge: flagging them would poison the real gate."""
+    _, findings, _ = fixture_run
+    bad = [f.message for f in findings
+           if "count_guarded_sync_ok" in f.message
+           or "count_branch_order_ok" in f.message
+           or "fetch_single_world_ok" in f.message]
+    assert bad == []
+
+
+def test_inline_suppression_applies_to_flow_findings():
+    """``# pertlint: disable=FL001`` drops the finding in flow_lint,
+    exactly like the AST and deep layers."""
+    result, _, _ = flow_lint(select={"FL001"}, package_root=FIXTURE_PKG)
+    sup = [f for f in result.suppressed if "suppressed_sync" in f.message]
+    assert len(sup) == 1
+    assert all("suppressed_sync" not in f.message for f in result.new)
+
+
+def test_fixture_identity_verdicts(fixture_run):
+    """The three verdict values, one fixture jit function each."""
+    _, _, stats = fixture_run
+    assert stats.verdicts["_render"] == "leak"
+    assert stats.verdicts["_kernel"] == "incomplete"
+    assert stats.verdicts["_stepper"] == "covered"
+
+
+def test_fixture_non_hash_fields_read_statically(fixture_run):
+    ctx, _, _ = fixture_run
+    assert ctx.non_hash_fields == ("telemetry_path", "request_id")
+
+
+# -- contract: identity map, artifact, NON_HASH_FIELDS --------------------
+
+def test_identity_map_covers_registry_exactly():
+    """A new deep entry point without an identity mapping fails loudly
+    here (and would gate as FL004 via the _unmapped row)."""
+    mapped = set(ENTRY_JIT) | set(_SYNTHETIC_ENTRIES)
+    assert mapped == set(entrypoints.REGISTRY), \
+        (sorted(mapped), sorted(entrypoints.REGISTRY))
+    assert not set(ENTRY_JIT) & set(_SYNTHETIC_ENTRIES)
+
+
+def test_program_identity_artifact_schema_and_roundtrip():
+    doc = json.loads(ARTIFACT.read_text())
+    assert doc["schema"] == SCHEMA
+    assert doc["package"] == "scdna_replication_tools_tpu"
+    assert doc["jit_cache_key_includes_jax_version"] is True
+    assert [e["name"] for e in doc["entries"]] == \
+        list(entrypoints.REGISTRY)
+    for e in doc["entries"]:
+        assert e["verdict"] in ("covered", "leak", "incomplete")
+        assert isinstance(e["line"], int) and e["line"] >= 1
+        assert e["identity_inputs"], e["name"]
+        for inp in e["identity_inputs"]:
+            assert inp["classification"] in ("covered", "leak",
+                                             "incomplete")
+            assert inp["provenance"] == sorted(inp["provenance"])
+    # round-trips bit-exactly through json
+    assert json.loads(json.dumps(doc)) == doc
+
+
+def test_program_identity_artifact_is_current_and_covered(head_ctx):
+    """The committed certificate equals a fresh regeneration (no drift)
+    and every registered entry point is hash-covered on HEAD — the
+    AOT-cache-key soundness claim this PR certifies."""
+    committed = json.loads(ARTIFACT.read_text())
+    assert committed == head_ctx.identity_report
+    assert all(e["verdict"] == "covered" for e in committed["entries"]), \
+        {e["name"]: e["verdict"] for e in committed["entries"]}
+
+
+def test_non_hash_fields_contract_single_sourced(head_ctx):
+    """config.NON_HASH_FIELDS is one literal tuple: statically readable
+    by the flow engine, real PertConfig fields, and echoed into the
+    committed certificate."""
+    from scdna_replication_tools_tpu.config import (
+        NON_HASH_FIELDS,
+        PertConfig,
+    )
+    assert non_hash_fields_of(head_ctx.graph) == NON_HASH_FIELDS
+    field_names = {f.name for f in dataclasses.fields(PertConfig)}
+    assert set(NON_HASH_FIELDS) <= field_names
+    doc = json.loads(ARTIFACT.read_text())
+    assert doc["non_hash_fields"] == sorted(NON_HASH_FIELDS)
+
+
+def test_config_digest_invariant_to_non_hash_fields():
+    """Satellite contract: moving EVERY excluded field leaves the run
+    digest unchanged; moving a behavioural field changes it."""
+    from scdna_replication_tools_tpu.config import (
+        NON_HASH_FIELDS,
+        PertConfig,
+    )
+    from scdna_replication_tools_tpu.obs.runlog import _config_digest
+
+    base = PertConfig()
+    moved = dataclasses.replace(
+        base, telemetry_path="/elsewhere/run.ndjson",
+        metrics_textfile="/elsewhere/metrics.prom",
+        request_id="req-42", trace_spans=True, trace_parent="aaaa:bbbb")
+    # the replacement above must exercise EVERY declared excluded field
+    changed = {f for f in NON_HASH_FIELDS
+               if getattr(moved, f) != getattr(base, f)}
+    assert changed == set(NON_HASH_FIELDS)
+    assert _config_digest(base) == _config_digest(moved)
+    behavioural = dataclasses.replace(base, max_iter=base.max_iter + 1)
+    assert _config_digest(base) != _config_digest(behavioural)
+
+
+def test_head_collective_fabric_is_seen(head_ctx):
+    """FL001's clean verdict must mean 'guards are sound', not 'the
+    analysis went blind': the barrier/checkpoint/consensus fabric is
+    visible as collective sites and a non-trivial reachable set."""
+    g = head_ctx.graph
+    sites = [s for fn in g.functions.values()
+             for s in g.collective_sites(fn)]
+    assert len(sites) >= 10, len(sites)
+    assert len(g.collective_bearing) >= 5
+    assert len(g.multiprocess_reachable) > len(g.collective_bearing)
+    assert not g.parse_errors, g.parse_errors
+
+
+# -- the gate -------------------------------------------------------------
+
+def test_flow_gate_is_clean_on_head():
+    """THE gate, in-process: zero unbaselined flow findings, every
+    baselined one rationalized, every entry point hash-covered."""
+    result, stats, _ = flow_lint(baseline_path=BASELINE)
+    assert result.new == [], [f.render() for f in result.new]
+    assert stats.unrationalized == []
+    assert set(stats.verdicts.values()) == {"covered"}, stats.verdicts
+
+
+def test_flow_cli_gate_subprocess(tmp_path):
+    """Exactly as CI runs it: ``python -m tools.pertlint --flow``."""
+    out = tmp_path / "PROGRAM_IDENTITY.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.pertlint", "--flow",
+         "--baseline", str(BASELINE), "--identity-out", str(out)],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "entry points certified" in proc.stdout
+    doc = json.loads(out.read_text())
+    assert doc["schema"] == SCHEMA
+
+
+def test_baselined_flow_findings_carry_rationale():
+    """Zero unexplained flow entries; error-severity FL findings must
+    be FIXED, not baselined — only the FL006 warning inventory (the
+    ROADMAP multi-host work list) may be grandfathered."""
+    entries = json.loads(BASELINE.read_text())["findings"]
+    fl = [e for e in entries if e["rule"].startswith("FL")]
+    assert fl, "expected the FL006 host-fetch inventory in the baseline"
+    assert {e["rule"] for e in fl} == {"FL006"}
+    for e in fl:
+        assert e.get("rationale"), f"FL entry without rationale: {e}"

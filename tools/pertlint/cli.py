@@ -1,11 +1,13 @@
 """``python -m tools.pertlint`` — the CI gate.
 
-Two analysis layers share one CLI, one baseline and one suppression
-syntax: the stdlib AST layer (PLnnn rules, runs over the given paths)
-and the deep jaxpr/sharding layer (DPnnn rules, ``--deep``; traces the
+Three analysis layers share one CLI, one baseline and one suppression
+syntax: the stdlib AST layer (PLnnn rules, runs over the given paths),
+the deep jaxpr/sharding layer (DPnnn rules, ``--deep``; traces the
 registered jit entry points on abstract inputs — needs jax, no
-devices).  ``--deep`` alone runs just the deep gate; paths plus
-``--deep`` runs both and gates on the union.
+devices), and the interprocedural flow layer (FLnnn rules, ``--flow``;
+whole-package call-graph + config-to-jit dataflow — stdlib only, and
+it also emits the ``PROGRAM_IDENTITY.json`` certificate).  Any
+combination runs the requested layers and gates on the union.
 
 Exit codes: 0 clean (no new error-severity findings), 1 new violations,
 2 usage/parse errors.  ``--write-baseline`` snapshots the current
@@ -33,17 +35,24 @@ from tools.pertlint.engine import (
 )
 
 DEFAULT_BASELINE = pathlib.Path(__file__).parent / "baseline.json"
+DEFAULT_IDENTITY_OUT = pathlib.Path("artifacts") / "PROGRAM_IDENTITY.json"
+
+_LAYERS = (("ast", "ast layer", ""),
+           ("deep", "deep jaxpr/sharding layer", "--deep"),
+           ("flow", "interprocedural flow layer", "--flow"))
 
 
 def _list_rules() -> str:
-    lines = ["pertlint rules (ast layer):"]
-    for rule in all_rules(kind="ast"):
-        lines.append(f"  {rule.id}  {rule.name:<20} [{rule.severity}] "
-                     f"{rule.description}")
-    lines.append("pertlint rules (deep layer, --deep):")
-    for rule in all_rules(kind="deep"):
-        lines.append(f"  {rule.id}  {rule.name:<20} [{rule.severity}] "
-                     f"{rule.description}")
+    """Roster computed from the registry — counts can never go stale."""
+    lines = []
+    for kind, label, flag in _LAYERS:
+        rules = all_rules(kind=kind)
+        suffix = f", {flag}" if flag else ""
+        lines.append(f"pertlint rules ({label}: {len(rules)} rules"
+                     f"{suffix}):")
+        for rule in rules:
+            lines.append(f"  {rule.id}  {rule.name:<28} [{rule.severity}] "
+                         f"{rule.description}")
     return "\n".join(lines)
 
 
@@ -62,7 +71,8 @@ def _warn(args, text: str) -> None:
         print(f"pertlint: warning: {text}", file=sys.stderr)
 
 
-def _render(args, result: LintResult, deep_stats=None) -> None:
+def _render(args, result: LintResult, deep_stats=None,
+            flow_stats=None) -> None:
     if args.format == "json":
         payload = {
             "files_checked": result.files_checked,
@@ -79,6 +89,15 @@ def _render(args, result: LintResult, deep_stats=None) -> None:
                 "skipped": deep_stats.skipped,
                 "contract_rows": deep_stats.contract_rows,
                 "unrationalized": deep_stats.unrationalized,
+            }
+        if flow_stats is not None:
+            payload["flow"] = {
+                "modules": flow_stats.modules,
+                "functions": flow_stats.functions,
+                "collective_bearing": flow_stats.collective_bearing,
+                "entries": flow_stats.entries,
+                "verdicts": flow_stats.verdicts,
+                "unrationalized": flow_stats.unrationalized,
             }
         print(json.dumps(payload, indent=1))
         return
@@ -101,6 +120,11 @@ def _render(args, result: LintResult, deep_stats=None) -> None:
                     f"finding(s) lack a 'rationale' — semantic debt needs "
                     f"a recorded WHY (edit the baseline entries: "
                     f"{', '.join(deep_stats.unrationalized)})")
+    if flow_stats is not None and flow_stats.unrationalized:
+        _warn(args, f"{len(flow_stats.unrationalized)} baselined flow "
+                    f"finding(s) lack a 'rationale' — semantic debt needs "
+                    f"a recorded WHY (edit the baseline entries: "
+                    f"{', '.join(flow_stats.unrationalized)})")
     gating = result.gating
     warnings = len(result.new) - len(gating)
     deep_note = ""
@@ -109,12 +133,21 @@ def _render(args, result: LintResult, deep_stats=None) -> None:
                      f"traced, {deep_stats.contract_rows} contract rows")
         if deep_stats.skipped:
             deep_note += f", {len(deep_stats.skipped)} skipped"
+    flow_note = ""
+    if flow_stats is not None:
+        v = flow_stats.verdicts
+        covered = sum(1 for x in v.values() if x == "covered")
+        flow_note = (f"; flow: {flow_stats.functions} functions in "
+                     f"{flow_stats.modules} modules, "
+                     f"{len(flow_stats.entries)} entry points certified "
+                     f"({covered}/{len(v)} hash-covered)")
     print(f"pertlint: {result.files_checked} files, "
           f"{len(gating)} new violation{'s' if len(gating) != 1 else ''}"
           + (f" + {warnings} warning{'s' if warnings != 1 else ''}"
              if warnings else "")
           + f" ({len(result.baselined)} baselined, "
-            f"{len(result.suppressed)} suppressed)" + deep_note)
+            f"{len(result.suppressed)} suppressed)"
+          + deep_note + flow_note)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -130,6 +163,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="also run the deep jaxpr/sharding layer "
                          "(DP rules; traces the registered jit entry "
                          "points on abstract inputs — needs jax, CPU only)")
+    ap.add_argument("--flow", action="store_true",
+                    help="also run the interprocedural flow layer "
+                         "(FL rules; whole-package call graph + "
+                         "config-to-jit dataflow — stdlib only, nothing "
+                         "is imported or traced) and write the "
+                         "program-identity certificate")
+    ap.add_argument("--identity-out", type=pathlib.Path,
+                    default=DEFAULT_IDENTITY_OUT,
+                    help="where --flow writes PROGRAM_IDENTITY.json "
+                         "(default: %(default)s; '-' to skip writing)")
     ap.add_argument("--baseline", type=pathlib.Path,
                     default=DEFAULT_BASELINE,
                     help="baseline file of grandfathered findings "
@@ -154,10 +197,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.list_rules:
         print(_list_rules())
         return 0
-    if not args.paths and not args.deep:
+    if not args.paths and not args.deep and not args.flow:
         ap.print_usage(sys.stderr)
-        print("error: no paths given (and --deep not requested)",
-              file=sys.stderr)
+        print("error: no paths given (and neither --deep nor --flow "
+              "requested)", file=sys.stderr)
         return 2
     if args.write_baseline and args.update_baseline:
         print("error: --write-baseline and --update-baseline are "
@@ -166,7 +209,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     ast_rules = all_rules(kind="ast")
     deep_ids = {r.id for r in all_rules(kind="deep")}
-    deep_select = None
+    flow_ids = {r.id for r in all_rules(kind="flow")}
+    deep_select = flow_select = None
     if args.select:
         wanted = {r.strip() for r in args.select.split(",") if r.strip()}
         known = {r.id for r in all_rules(kind=None)}
@@ -182,17 +226,39 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"{sorted(wanted & deep_ids)} require --deep",
                   file=sys.stderr)
             return 2
+        if (wanted & flow_ids) and not args.flow:
+            print(f"error: selected flow rule(s) "
+                  f"{sorted(wanted & flow_ids)} require --flow",
+                  file=sys.stderr)
+            return 2
         ast_rules = [r for r in ast_rules if r.id in wanted]
-        deep_select = wanted
+        deep_select = flow_select = wanted
 
+    baseline = None if args.no_baseline else args.baseline
     deep_result = deep_stats = None
     deep_fingerprinted = []
     if args.deep:
         from tools.pertlint.deep.engine import deep_lint
 
-        baseline = None if args.no_baseline else args.baseline
         deep_result, deep_stats, deep_fingerprinted = deep_lint(
             select=deep_select, baseline_path=baseline)
+
+    flow_result = flow_stats = None
+    flow_fingerprinted = []
+    if args.flow:
+        from tools.pertlint.flow.engine import flow_lint
+
+        flow_result, flow_stats, flow_fingerprinted = flow_lint(
+            select=flow_select, baseline_path=baseline)
+        if str(args.identity_out) != "-" and flow_stats.entries:
+            args.identity_out.parent.mkdir(parents=True, exist_ok=True)
+            args.identity_out.write_text(
+                json.dumps(flow_stats.identity_report, indent=1,
+                           sort_keys=False) + "\n")
+
+    extra_fingerprinted = deep_fingerprinted + flow_fingerprinted
+    extra_rule_ids = (deep_ids if args.deep else set()) \
+        | (flow_ids if args.flow else set())
 
     if args.write_baseline:
         if args.select:
@@ -204,34 +270,35 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "grandfathered entries)", file=sys.stderr)
             return 2
         n = snapshot_baseline(args.paths, args.baseline, rules=ast_rules,
-                              extra_fingerprinted=deep_fingerprinted,
-                              extra_rule_ids=deep_ids if args.deep
-                              else set())
+                              extra_fingerprinted=extra_fingerprinted,
+                              extra_rule_ids=extra_rule_ids)
         print(f"pertlint: baseline written to {args.baseline} "
               f"({n} grandfathered finding{'s' if n != 1 else ''}; "
               f"entries outside the given paths/rules retained)")
-        if deep_fingerprinted:
+        if extra_fingerprinted:
             print("pertlint: note: add a one-line 'rationale' to every "
-                  "new DP entry — deep debt without a WHY does not pass "
-                  "review")
+                  "new DP/FL entry — semantic debt without a WHY does "
+                  "not pass review")
         return 0
 
     if args.update_baseline:
-        extra_produced = {fp for _, fp in deep_fingerprinted}
-        # only the deep rules that actually RAN may prune their entries
-        extra_rule_ids = set()
+        extra_produced = {fp for _, fp in extra_fingerprinted}
+        # only the deep/flow rules that actually RAN may prune entries
+        prunable = set()
         if args.deep:
-            extra_rule_ids = (deep_ids & deep_select if deep_select
-                              else deep_ids)
+            prunable |= (deep_ids & deep_select if deep_select
+                         else deep_ids)
+        if args.flow:
+            prunable |= (flow_ids & flow_select if flow_select
+                         else flow_ids)
         kept, pruned = update_baseline(
             args.paths, args.baseline, rules=ast_rules,
-            extra_produced=extra_produced, extra_rule_ids=extra_rule_ids)
+            extra_produced=extra_produced, extra_rule_ids=prunable)
         print(f"pertlint: baseline updated — {kept} entries kept, "
               f"{pruned} stale/dead entr{'ies' if pruned != 1 else 'y'} "
               f"pruned")
         return 0
 
-    baseline = None if args.no_baseline else args.baseline
     result = LintResult(new=[], baselined=[], suppressed=[],
                         stale_baseline=set(), parse_errors=[])
     if args.paths:
@@ -239,8 +306,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                             rules=ast_rules)
     if deep_result is not None:
         result = result.merge(deep_result)
+    if flow_result is not None:
+        result = result.merge(flow_result)
 
-    _render(args, result, deep_stats)
+    _render(args, result, deep_stats, flow_stats)
 
     if result.parse_errors:
         return 2

@@ -8,7 +8,7 @@ and a ``check(ctx)`` generator yielding :class:`Finding`.  Registration
 is a decorator so each rule module is self-contained and
 ``rules/__init__.py`` only has to import them.
 
-Two rule KINDS share the registry:
+Three rule KINDS share the registry:
 
 * ``ast`` (PLnnn) — pure-stdlib source-text rules; ``check`` receives an
   ``engine.FileContext``;
@@ -16,7 +16,12 @@ Two rule KINDS share the registry:
   receives a context built by ``tools.pertlint.deep.engine`` (a
   ``ProgramContext`` per jit entry point, or the layout contract).  The
   deep rule CLASSES are stdlib-importable (jax is imported only when a
-  deep check actually runs) so ``--list-rules`` works without jax.
+  deep check actually runs) so ``--list-rules`` works without jax;
+* ``flow`` (FLnnn) — interprocedural rules over the whole-package call
+  graph (SPMD collective discipline, config-to-jit program-identity
+  dataflow); ``check`` receives a ``FlowContext`` built by
+  ``tools.pertlint.flow.engine``.  Pure stdlib end to end — the flow
+  layer parses, it never imports the analysed package.
 """
 
 from __future__ import annotations
@@ -25,12 +30,12 @@ import dataclasses
 from typing import Dict, Iterable, List, Optional, Type
 
 SEVERITIES = ("error", "warning")
-KINDS = ("ast", "deep")
+KINDS = ("ast", "deep", "flow")
 
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
-    rule: str       # "PL001" / "DP003"
+    rule: str       # "PL001" / "DP003" / "FL001"
     severity: str   # "error" | "warning"
     path: str       # posix path as given to the engine (repo-relative in CI)
     line: int       # 1-based, the AST node's lineno
@@ -62,7 +67,7 @@ class Rule:
 
 _REGISTRY: Dict[str, Type[Rule]] = {}
 
-_PREFIX_BY_KIND = {"ast": "PL", "deep": "DP"}
+_PREFIX_BY_KIND = {"ast": "PL", "deep": "DP", "flow": "FL"}
 
 
 def register(cls: Type[Rule]) -> Type[Rule]:
@@ -84,12 +89,14 @@ def all_rules(kind: Optional[str] = "ast") -> List[Rule]:
     """Fresh instances of every registered rule of ``kind``, id-ordered.
 
     Default is the AST layer — the engine's and tests' historical
-    contract.  ``kind='deep'`` returns the jaxpr/sharding rules;
-    ``kind=None`` returns both (the CLI's ``--list-rules``).  Importing
-    either rule package is stdlib-only.
+    contract.  ``kind='deep'`` returns the jaxpr/sharding rules,
+    ``kind='flow'`` the interprocedural call-graph rules;
+    ``kind=None`` returns all three (the CLI's ``--list-rules``).
+    Importing any rule package is stdlib-only.
     """
     import tools.pertlint.rules  # noqa: F401 — importing registers them
     import tools.pertlint.deep.rules_jaxpr  # noqa: F401
     import tools.pertlint.deep.rules_sharding  # noqa: F401
+    import tools.pertlint.flow.rules_flow  # noqa: F401
     return [_REGISTRY[rid]() for rid in sorted(_REGISTRY)
             if kind is None or _REGISTRY[rid].kind == kind]

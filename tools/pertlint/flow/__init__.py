@@ -1,0 +1,24 @@
+"""pertlint-flow: the interprocedural SPMD/program-identity layer.
+
+Third analysis layer beside the AST rules (PLnnn, per-file) and the
+deep jaxpr/sharding layer (DPnnn, traced programs).  The flow layer
+(FLnnn) parses the WHOLE package once, builds a call graph with
+per-function summaries (rank/count taint, guard stacks, collective
+closure) and dataflow from ``PertConfig`` fields to the jit
+boundaries, then checks two properties nothing per-file or per-program
+can see:
+
+* **SPMD discipline** — no collective (``barrier``,
+  ``sync_global_devices``, allgather, the two-phase checkpoint commit)
+  is reachable only under rank-divergent control flow (FL001/FL002),
+  and the host-global-fetch sites that block mesh-native multi-host
+  decode are inventoried (FL006);
+* **program identity** — the config hash provably covers everything
+  that reaches compiled-program identity (static argnames, shapes,
+  dtypes) while the hash-excluded fields provably never do
+  (FL003/FL004/FL005), certified per entry point in
+  ``artifacts/PROGRAM_IDENTITY.json``.
+
+Pure stdlib (ast + tokenize): ``python -m tools.pertlint --flow``
+needs no jax and traces nothing — it reads source.
+"""

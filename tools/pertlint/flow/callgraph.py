@@ -1,0 +1,603 @@
+"""Whole-package call graph + per-function flow summaries.
+
+One parse of every module under the package root produces a
+:class:`PackageGraph`:
+
+* functions/methods indexed by dotted qualname, with import-resolved
+  call edges;
+* per-function **rank/count taint**: values derived from
+  ``jax.process_index()`` (or slot 0 of ``process_rank_and_count()``)
+  are *rank*-tainted — they DIVERGE across processes; values derived
+  from ``jax.process_count()`` (or slot 1) are *count*-tainted — they
+  are SPMD-uniform, so ``if jax.process_count() > 1:`` around a
+  collective is sound while ``if jax.process_index() == 0:`` is a
+  deadlock;
+* per-call-site **guard stacks**: the conditional context (if/else
+  branch with its taint, except arm, the shadow of a rank-guarded
+  early return) each call executes under;
+* the **collective-bearing closure**: functions that transitively
+  reach a collective primitive (``sync_global_devices``,
+  ``process_allgather``, ``broadcast_one_to_all``), so calling
+  ``barrier()`` under a rank guard is as much a finding as calling
+  the primitive itself.
+
+Everything is stdlib ``ast`` — nothing is imported or executed, so the
+same machinery analyses the real package and the seeded-defect test
+fixtures alike.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+# dotted-name SUFFIXES that are collective primitives: any path that
+# reaches one must be taken by every process in lockstep
+COLLECTIVE_ROOTS = (
+    "sync_global_devices",
+    "process_allgather",
+    "broadcast_one_to_all",
+)
+
+# host-side fetch of (potentially globally-sharded) array values — the
+# FL006 inventory; each call materialises addressable shards only, so
+# on >1 process it silently computes on a fraction of the data
+HOST_FETCH_RAW = {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "jax.device_get", "device_get",
+}
+
+# parameter names that carry a process rank / a process count across a
+# function boundary (the package idiom: ``kproc, nproc =
+# process_rank_and_count()`` then helpers take one or the other)
+RANK_PARAM_NAMES = {"process_index", "proc_index", "kproc", "rank",
+                    "host_rank"}
+COUNT_PARAM_NAMES = {"process_count", "proc_count", "nproc", "n_proc",
+                     "num_processes", "world_size"}
+
+RANK = "rank"
+COUNT = "count"
+NONE = "none"
+UNKNOWN = "unknown"
+
+
+def dotted_name(expr: ast.expr) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        base = dotted_name(expr.value)
+        return f"{base}.{expr.attr}" if base else None
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class Guard:
+    """One conditional frame a statement executes under."""
+    kind: str        # "if" | "else" | "except" | "after-return"
+    taint: str       # RANK | COUNT | NONE | UNKNOWN
+    line: int
+    test_text: str
+    # for COUNT guards only: which world the guarded branch is —
+    # "single" (process_count <= 1 branch) or "multi"; None otherwise
+    count_world: Optional[str] = None
+
+
+@dataclasses.dataclass
+class CallSite:
+    raw: str                    # the call target as written ("np.asarray")
+    resolved: Optional[str]     # package-dotted qualname, or None
+    node: ast.Call
+    guards: Tuple[Guard, ...]
+
+    @property
+    def line(self) -> int:
+        return self.node.lineno
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    qualname: str               # module.Class.method / module.func
+    module: str
+    path: str
+    node: ast.AST               # FunctionDef | AsyncFunctionDef
+    cls: Optional[str]          # enclosing class name, if a method
+    params: List[str]
+    # enclosing function's qualname for a nested def (closure scope) —
+    # free variables inside the body resolve against this chain
+    parent: Optional[str] = None
+    calls: List[CallSite] = dataclasses.field(default_factory=list)
+    rank_names: Set[str] = dataclasses.field(default_factory=set)
+    count_names: Set[str] = dataclasses.field(default_factory=set)
+
+    @property
+    def line(self) -> int:
+        return self.node.lineno
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    name: str                   # dotted module name
+    path: str
+    tree: ast.Module
+    imports: Dict[str, str]     # local name -> dotted target
+    # module-level constant assignments (Name -> value expr)
+    constants: Dict[str, ast.expr] = dataclasses.field(default_factory=dict)
+    # class attr assignments seen anywhere in the class body/methods:
+    # (class, attr) -> [value exprs] — resolves ``self._x`` one level
+    class_attrs: Dict[Tuple[str, str], List[ast.expr]] = \
+        dataclasses.field(default_factory=dict)
+    # top-level defs/classes, for bare-name call resolution
+    toplevel: Set[str] = dataclasses.field(default_factory=set)
+
+
+def _import_map(tree: ast.Module, module: str, package: str
+                ) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    parts = module.split(".")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                out[alias.asname or alias.name.split(".")[0]] = \
+                    alias.name if alias.asname else alias.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                # relative: level=1 is the module's own package
+                base = parts[:len(parts) - node.level]
+                mod = ".".join(base + ([node.module] if node.module else []))
+            else:
+                mod = node.module or ""
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                out[alias.asname or alias.name] = f"{mod}.{alias.name}" \
+                    if mod else alias.name
+    return out
+
+
+class PackageGraph:
+    """Parsed view of one package: modules, functions, call edges."""
+
+    def __init__(self, root: pathlib.Path, package: Optional[str] = None):
+        self.root = pathlib.Path(root)
+        self.package = package or self.root.name
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.parse_errors: List[Tuple[str, str]] = []
+        self._parse_all()
+        self._index_functions()
+        self._summarise()
+        self.collective_bearing = self._collective_closure()
+        self.multiprocess_reachable = self._multiprocess_closure()
+
+    # -- construction -----------------------------------------------------
+
+    def _parse_all(self) -> None:
+        for f in sorted(self.root.rglob("*.py")):
+            if "__pycache__" in f.parts:
+                continue
+            rel = f.relative_to(self.root)
+            mod_parts = [self.package] + list(rel.parts[:-1])
+            stem = rel.stem
+            if stem != "__init__":
+                mod_parts.append(stem)
+            name = ".".join(mod_parts)
+            try:
+                tree = ast.parse(f.read_text(), filename=str(f))
+            except (SyntaxError, UnicodeDecodeError) as exc:
+                self.parse_errors.append(
+                    (f.as_posix(), f"{type(exc).__name__}: {exc}"))
+                continue
+            info = ModuleInfo(name=name, path=f.as_posix(), tree=tree,
+                              imports=_import_map(tree, name, self.package))
+            for node in tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    info.toplevel.add(node.name)
+                elif isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            info.constants[tgt.id] = node.value
+                elif isinstance(node, ast.AnnAssign) and node.value and \
+                        isinstance(node.target, ast.Name):
+                    info.constants[node.target.id] = node.value
+            self.modules[name] = info
+
+    def _index_functions(self) -> None:
+        for mod in self.modules.values():
+            for node in mod.tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._add_function(mod, node, cls=None)
+                elif isinstance(node, ast.ClassDef):
+                    for sub in node.body:
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                            self._add_function(mod, sub, cls=node.name)
+                    self._collect_class_attrs(mod, node)
+
+    def _add_function(self, mod: ModuleInfo, node, cls: Optional[str],
+                      parent: Optional[str] = None) -> None:
+        if parent:
+            qual = f"{parent}.{node.name}"
+        elif cls:
+            qual = f"{mod.name}.{cls}.{node.name}"
+        else:
+            qual = f"{mod.name}.{node.name}"
+        a = node.args
+        params = [p.arg for p in
+                  (a.posonlyargs + a.args + a.kwonlyargs)]
+        if a.vararg:
+            params.append(a.vararg.arg)
+        if a.kwarg:
+            params.append(a.kwarg.arg)
+        self.functions[qual] = FunctionInfo(
+            qualname=qual, module=mod.name, path=mod.path, node=node,
+            cls=cls, params=params, parent=parent)
+        # nested defs (closures, local callbacks) are indexed under the
+        # enclosing function's qualname; they inherit `cls` so a
+        # ``self.method(...)`` call inside a closure still resolves.
+        # Only direct statement nesting is walked — a def inside a
+        # nested ClassDef is out of scope for this analysis.
+        for sub in ast.walk(node):
+            if sub is node or not isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if self._direct_parent_function(node, sub) is node:
+                self._add_function(mod, sub, cls=cls, parent=qual)
+
+    @staticmethod
+    def _direct_parent_function(outer, target) -> Optional[ast.AST]:
+        """The innermost enclosing function def of ``target`` under
+        ``outer`` (``outer`` itself when directly nested)."""
+        found = [None]
+
+        def walk(node, owner):
+            for child in ast.iter_child_nodes(node):
+                if child is target:
+                    found[0] = owner
+                    return
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    walk(child, child)
+                elif not isinstance(child, ast.ClassDef):
+                    walk(child, owner)
+
+        walk(outer, outer)
+        return found[0]
+
+    def _collect_class_attrs(self, mod: ModuleInfo, cls: ast.ClassDef
+                             ) -> None:
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        mod.class_attrs.setdefault(
+                            (cls.name, tgt.attr), []).append(node.value)
+
+    # -- name resolution --------------------------------------------------
+
+    def resolve_call(self, raw: Optional[str], fn: FunctionInfo
+                     ) -> Optional[str]:
+        """Map a written call target to a package qualname, if it is one."""
+        if not raw:
+            return None
+        mod = self.modules[fn.module]
+        head, _, rest = raw.partition(".")
+        if head == "self" and fn.cls and rest:
+            meth = rest.split(".")[0]
+            cand = f"{fn.module}.{fn.cls}.{meth}"
+            if cand in self.functions:
+                return cand
+            return None
+        # a bare name may be a nested def of this function or of an
+        # enclosing one (closure call) — Python scoping: local first
+        if not rest:
+            scope: Optional[str] = fn.qualname
+            while scope is not None:
+                cand = f"{scope}.{head}"
+                if cand in self.functions:
+                    return cand
+                scope = self.functions[scope].parent \
+                    if scope in self.functions else None
+        target = None
+        if head in mod.imports:
+            target = mod.imports[head] + (f".{rest}" if rest else "")
+        elif head in mod.toplevel:
+            target = f"{fn.module}.{raw}"
+        elif not rest and head in self.functions_in(fn.module):
+            target = f"{fn.module}.{head}"
+        if target is None:
+            return None
+        if target in self.functions:
+            return target
+        # 'pkg.mod.Class.method' / 'pkg.mod.func' via module import
+        if target.startswith(self.package + ".") or target == self.package:
+            if target in self.functions:
+                return target
+            # maybe it names a class: Class(...) constructor — map to
+            # __init__ so taint flows into the constructor
+            init = f"{target}.__init__"
+            if init in self.functions:
+                return init
+        return target if target in self.functions else None
+
+    def functions_in(self, module: str) -> Set[str]:
+        return {q.rsplit(".", 1)[1] for q in self.functions
+                if self.functions[q].module == module}
+
+    # -- per-function summaries -------------------------------------------
+
+    def _summarise(self) -> None:
+        for fn in self.functions.values():
+            self._taint_pass(fn)
+            self._guard_walk(fn)
+
+    def _taint_pass(self, fn: FunctionInfo) -> None:
+        for p in fn.params:
+            if p in RANK_PARAM_NAMES:
+                fn.rank_names.add(p)
+            elif p in COUNT_PARAM_NAMES:
+                fn.count_names.add(p)
+        # two passes: a later assignment may feed an earlier-read name
+        # in loops; the sets only grow, so twice reaches the fixpoint
+        # for everything that matters here
+        for _ in range(2):
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Assign):
+                    self._taint_assign(fn, node.targets, node.value)
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    self._taint_assign(fn, [node.target], node.value)
+                elif isinstance(node, ast.AugAssign):
+                    self._taint_assign(fn, [node.target], node.value)
+
+    def _taint_assign(self, fn: FunctionInfo, targets, value) -> None:
+        # rank/count tuple unpack: a, b = process_rank_and_count()
+        if (isinstance(value, ast.Call)
+                and (dotted_name(value.func) or "").endswith(
+                    "process_rank_and_count")):
+            for tgt in targets:
+                if isinstance(tgt, (ast.Tuple, ast.List)) \
+                        and len(tgt.elts) == 2:
+                    if isinstance(tgt.elts[0], ast.Name):
+                        fn.rank_names.add(tgt.elts[0].id)
+                    if isinstance(tgt.elts[1], ast.Name):
+                        fn.count_names.add(tgt.elts[1].id)
+                elif isinstance(tgt, ast.Name):
+                    fn.rank_names.add(tgt.id)   # whole tuple: divergent
+            return
+        t = self.expr_taint(value, fn)
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                if t == RANK:
+                    fn.rank_names.add(tgt.id)
+                elif t == COUNT:
+                    fn.count_names.add(tgt.id)
+            elif isinstance(tgt, (ast.Tuple, ast.List)) and t == RANK:
+                for e in tgt.elts:
+                    if isinstance(e, ast.Name):
+                        fn.rank_names.add(e.id)
+
+    def expr_taint(self, expr: ast.expr, fn: FunctionInfo) -> str:
+        """RANK if the value diverges across processes, COUNT if it is
+        the (uniform) world size, NONE if process-independent."""
+        if isinstance(expr, ast.Call):
+            raw = dotted_name(expr.func) or ""
+            if raw.endswith("process_index"):
+                return RANK
+            if raw.endswith("process_count"):
+                return COUNT
+            if raw.endswith("process_rank_and_count"):
+                return RANK          # the tuple itself: divergent part
+            sub = [self.expr_taint(a, fn) for a in expr.args] + \
+                  [self.expr_taint(k.value, fn) for k in expr.keywords]
+            return _join(sub)
+        if isinstance(expr, ast.Name):
+            if expr.id in fn.rank_names:
+                return RANK
+            if expr.id in fn.count_names:
+                return COUNT
+            return NONE
+        if isinstance(expr, ast.Attribute):
+            if expr.attr == "process_index":
+                return RANK
+            if expr.attr == "process_count":
+                return COUNT
+            return NONE
+        if isinstance(expr, ast.Constant):
+            return NONE
+        if isinstance(expr, (ast.Compare, ast.BoolOp, ast.BinOp,
+                             ast.UnaryOp, ast.IfExp)):
+            return _join([self.expr_taint(c, fn) for c in
+                          ast.iter_child_nodes(expr)
+                          if isinstance(c, ast.expr)])
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            return _join([self.expr_taint(e, fn) for e in expr.elts])
+        if isinstance(expr, ast.Subscript):
+            return self.expr_taint(expr.value, fn)
+        return NONE
+
+    def _count_world(self, test: ast.expr, fn: FunctionInfo
+                     ) -> Optional[str]:
+        """For a COUNT-tainted comparison: does the TRUE branch mean a
+        single-process world ('count <= 1') or a multi-process one
+        ('count > 1')?  None when the pattern is not recognised."""
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            inner = self._count_world(test.operand, fn)
+            return {"single": "multi", "multi": "single"}.get(inner or "")
+        if not (isinstance(test, ast.Compare) and len(test.ops) == 1
+                and len(test.comparators) == 1):
+            return None
+        left, op, right = test.left, test.ops[0], test.comparators[0]
+        if self.expr_taint(right, fn) == COUNT and \
+                isinstance(left, ast.Constant):
+            # normalise '1 < count' to 'count > 1' etc.
+            left, right = right, left
+            op = {ast.Lt: ast.Gt, ast.Gt: ast.Lt,
+                  ast.LtE: ast.GtE, ast.GtE: ast.LtE}.get(type(op),
+                                                          type(op))()
+        if self.expr_taint(left, fn) != COUNT or \
+                not isinstance(right, ast.Constant):
+            return None
+        v = right.value
+        if not isinstance(v, int):
+            return None
+        if isinstance(op, ast.LtE) and v == 1 or \
+                isinstance(op, ast.Lt) and v == 2 or \
+                isinstance(op, ast.Eq) and v == 1:
+            return "single"
+        if isinstance(op, ast.Gt) and v == 1 or \
+                isinstance(op, ast.GtE) and v == 2 or \
+                isinstance(op, ast.NotEq) and v == 1:
+            return "multi"
+        return None
+
+    def _guard_walk(self, fn: FunctionInfo) -> None:
+        src_seg = getattr(ast, "unparse", None)
+
+        def text(node) -> str:
+            try:
+                return src_seg(node) if src_seg else "<cond>"
+            except Exception:  # noqa: BLE001 — display only
+                return "<cond>"
+
+        def record_calls(node: ast.AST, guards: Tuple[Guard, ...]) -> None:
+            # record calls of this statement WITHOUT descending into
+            # nested statement-bearing constructs (handled by walk)
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    raw = dotted_name(sub.func) or ""
+                    fn.calls.append(CallSite(
+                        raw=raw, resolved=self.resolve_call(raw, fn),
+                        node=sub, guards=guards))
+
+        def terminal(stmts: List[ast.stmt]) -> bool:
+            return bool(stmts) and isinstance(
+                stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+        def walk(stmts: List[ast.stmt], guards: Tuple[Guard, ...]) -> None:
+            shadow = guards
+            for s in stmts:
+                if isinstance(s, ast.If):
+                    record_calls(s.test, shadow)
+                    t = self.expr_taint(s.test, fn)
+                    world = self._count_world(s.test, fn) \
+                        if t == COUNT else None
+                    g_if = Guard("if", t, s.lineno, text(s.test), world)
+                    g_el = Guard("else", t, s.lineno, text(s.test),
+                                 {"single": "multi",
+                                  "multi": "single"}.get(world or ""))
+                    walk(s.body, shadow + (g_if,))
+                    walk(s.orelse, shadow + (g_el,))
+                    if t == RANK and (terminal(s.body)
+                                      or terminal(s.orelse)):
+                        # a rank-guarded early return splits the world:
+                        # everything after runs on a rank subset
+                        shadow = shadow + (Guard(
+                            "after-return", RANK, s.lineno, text(s.test)),)
+                elif isinstance(s, ast.Try):
+                    walk(s.body, shadow)
+                    for h in s.handlers:
+                        walk(h.body, shadow + (Guard(
+                            "except", UNKNOWN, h.lineno,
+                            text(h.type) if h.type else "Exception"),))
+                    walk(s.orelse, shadow)
+                    walk(s.finalbody, shadow)
+                elif isinstance(s, (ast.For, ast.AsyncFor)):
+                    record_calls(s.iter, shadow)
+                    walk(s.body, shadow)
+                    walk(s.orelse, shadow)
+                elif isinstance(s, (ast.While,)):
+                    record_calls(s.test, shadow)
+                    walk(s.body, shadow)
+                    walk(s.orelse, shadow)
+                elif isinstance(s, (ast.With, ast.AsyncWith)):
+                    for item in s.items:
+                        record_calls(item.context_expr, shadow)
+                    walk(s.body, shadow)
+                elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.ClassDef)):
+                    # nested defs are indexed separately; a def is not
+                    # a call — its body's guards start fresh there
+                    continue
+                else:
+                    record_calls(s, shadow)
+
+        walk(fn.node.body, ())
+
+    # -- closures ---------------------------------------------------------
+
+    def _is_collective_root(self, site: CallSite) -> bool:
+        return any(site.raw.endswith(root) for root in COLLECTIVE_ROOTS)
+
+    def _collective_closure(self) -> Set[str]:
+        bearing: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.functions.values():
+                if fn.qualname in bearing:
+                    continue
+                for site in fn.calls:
+                    if self._is_collective_root(site) or \
+                            (site.resolved in bearing):
+                        bearing.add(fn.qualname)
+                        changed = True
+                        break
+        return bearing
+
+    def _multiprocess_closure(self) -> Set[str]:
+        """Functions that run during a multi-host run: the collective-
+        bearing set plus everything they (transitively) call — the
+        scope of the FL006 host-fetch inventory."""
+        reach: Set[str] = set(self.collective_bearing)
+        work = list(reach)
+        while work:
+            q = work.pop()
+            fn = self.functions.get(q)
+            if fn is None:
+                continue
+            for site in fn.calls:
+                tgt = site.resolved
+                if tgt and tgt in self.functions and tgt not in reach:
+                    reach.add(tgt)
+                    work.append(tgt)
+        return reach
+
+    # -- queries the rules use --------------------------------------------
+
+    def collective_sites(self, fn: FunctionInfo) -> List[CallSite]:
+        """Call sites in ``fn`` that issue (or reach) a collective."""
+        return [s for s in fn.calls
+                if self._is_collective_root(s)
+                or (s.resolved in self.collective_bearing)]
+
+    def host_fetch_sites(self, fn: FunctionInfo) -> List[CallSite]:
+        return [s for s in fn.calls if s.raw in HOST_FETCH_RAW]
+
+    def rel_path(self, path: str) -> str:
+        """Path as findings should report it: relative to the repo when
+        under cwd, else as parsed."""
+        p = pathlib.Path(path)
+        try:
+            return p.relative_to(pathlib.Path.cwd()).as_posix()
+        except ValueError:
+            return p.as_posix()
+
+
+def _join(taints: Sequence[str]) -> str:
+    if RANK in taints:
+        return RANK
+    if UNKNOWN in taints:
+        return UNKNOWN
+    if COUNT in taints:
+        return COUNT
+    return NONE
+
+
+def build_graph(root: pathlib.Path, package: Optional[str] = None
+                ) -> PackageGraph:
+    return PackageGraph(root, package)

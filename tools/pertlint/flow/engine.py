@@ -1,0 +1,324 @@
+"""Drive the flow pass and feed it through pertlint's shared machinery.
+
+``flow_lint`` parses the whole package once (stdlib ast — nothing is
+imported or traced), builds the call graph + taint summaries, computes
+the per-entry-point program-identity report, runs the FL rules, then
+applies the SAME inline-suppression and content-addressed-baseline
+filtering as the AST and deep layers — ``python -m tools.pertlint
+--flow`` is the third gate with the same one workflow.
+
+Flow findings anchor at real source lines (the collective call, the
+jit call site, the jit decoration), so ``# pertlint: disable=FL001``
+suppresses in place and baseline entries are content-addressed to the
+line's text.  Like the deep layer, baselined flow entries are expected
+to carry a one-line ``rationale``.
+
+The identity report (``FlowStats.identity_report``) is the payload of
+``artifacts/PROGRAM_IDENTITY.json`` — the machine-readable certificate
+the persisted AOT executable cache keys against: per registered deep
+entry point, its identity inputs, their config-field provenance, and a
+hash-coverage verdict (``covered`` / ``leak`` / ``incomplete``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pathlib
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.pertlint import baseline as baseline_mod
+from tools.pertlint import suppress
+from tools.pertlint.core import Finding, Rule, all_rules
+from tools.pertlint.engine import LintResult
+from tools.pertlint.flow import callgraph as cg
+from tools.pertlint.flow import identity as ident
+from tools.pertlint.flow.rules_flow import FlowContext
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[3]
+DEFAULT_PACKAGE_ROOT = REPO_ROOT / "scdna_replication_tools_tpu"
+
+# deep-registry entry name -> package-relative jit function, for the
+# entries whose identity IS a jit decoration.  test_pertlint_flow pins
+# that this map + _SYNTHETIC_ENTRIES covers the registry exactly, so a
+# new deep entry point without an identity mapping fails loudly.
+ENTRY_JIT = {
+    "fit": "infer.svi._run_fit",
+    "fit_chunk": "infer.svi._run_fit_chunk",
+    "fit_chunk_binary": "infer.svi._run_fit_chunk",
+    "decode_slab": "models.pert._decode_slab",
+    "decode_slab_binary": "models.pert._decode_slab",
+    "ppc": "models.pert._ppc_slab",
+}
+
+# entries whose program identity is structural, not a jit decoration:
+# anchor-function suffix, provenance atoms, note
+_SYNTHETIC_ENTRIES = {
+    "loss": ("._PertLossFn.__call__", ("model-spec",),
+             "identity is the frozen PertModelSpec (hashable by value) "
+             "— itself built from hash-included fields (P, K, J, "
+             "upsilon, ...) plus data dims"),
+    "sharded_batch": (".shard_batch",
+                      ("layout-contract", "bucket:cells", "bucket:loci"),
+                      "identity is the mesh extents + the layout "
+                      "factory's PartitionSpecs — the DP006/DP007 "
+                      "machine-checked contract"),
+    "sharded_params": (".shard_params",
+                       ("layout-contract", "bucket:cells", "bucket:loci"),
+                       "identity is the mesh extents + the layout "
+                       "factory's PartitionSpecs — the DP006/DP007 "
+                       "machine-checked contract"),
+}
+
+# per-entry provenance of the dynamic arg shapes/dtypes: the pad/chunk
+# knobs are hash-included config fields; the rest is the data itself
+_SHAPE_PROVENANCE = {
+    "fit": ("config:pad_cells_to", "config:pad_loci_to",
+            "config:cell_chunk", "data-shape"),
+    "fit_chunk": ("config:pad_cells_to", "config:pad_loci_to",
+                  "config:cell_chunk", "data-shape"),
+    "fit_chunk_binary": ("config:pad_cells_to", "config:pad_loci_to",
+                         "config:cell_chunk", "data-shape"),
+    "decode_slab": ("config:cell_chunk", "data-shape"),
+    "decode_slab_binary": ("config:cell_chunk", "data-shape"),
+    "ppc": ("config:cell_chunk", "data-shape"),
+}
+
+
+@dataclasses.dataclass
+class FlowStats:
+    """Run facts the CLI reports next to the LintResult."""
+    modules: int
+    functions: int
+    collective_bearing: int
+    entries: List[str]                  # identity-certified entry names
+    verdicts: Dict[str, str]            # entry -> covered|leak|incomplete
+    identity_report: dict
+    unrationalized: List[str] = dataclasses.field(default_factory=list)
+
+
+def _flow_rules(select: Optional[Set[str]] = None) -> List[Rule]:
+    rules = all_rules(kind="flow")
+    if select is not None:
+        rules = [r for r in rules if r.id in select]
+    return rules
+
+
+def non_hash_fields_of(graph: cg.PackageGraph) -> Tuple[str, ...]:
+    """The declared hash-exclusion contract, read from the package's
+    ``config.NON_HASH_FIELDS`` constant — statically, so fixtures can
+    declare their own."""
+    mod = graph.modules.get(f"{graph.package}.config")
+    if mod is None:
+        return ()
+    const = mod.constants.get("NON_HASH_FIELDS")
+    if const is None:
+        return ()
+    return ident._tuple_of_strings(const) or ()
+
+
+def _registry_names() -> List[str]:
+    """The deep registry's entry names (entrypoints.py is importable
+    without jax — the ``--list-rules`` contract the deep layer keeps)."""
+    from tools.pertlint.deep import entrypoints
+    return list(entrypoints.REGISTRY)
+
+
+def _find_suffix(graph: cg.PackageGraph, suffix: str
+                 ) -> Optional[cg.FunctionInfo]:
+    for qual, fn in graph.functions.items():
+        if qual.endswith(suffix):
+            return fn
+    return None
+
+
+def build_identity_report(graph: cg.PackageGraph,
+                          resolver: ident.ProvenanceResolver,
+                          jit_entries: Dict[str, ident.JitEntry],
+                          non_hash_fields: Tuple[str, ...],
+                          registry_names: Optional[Sequence[str]] = None
+                          ) -> dict:
+    """The PROGRAM_IDENTITY.json payload.
+
+    With ``registry_names`` (the real package): one row per registered
+    deep entry point, via ENTRY_JIT/_SYNTHETIC_ENTRIES.  Without (test
+    fixtures): one row per discovered jit function, keyed by its name.
+    """
+    entries: List[dict] = []
+    if registry_names is None:
+        for qual, entry in sorted(jit_entries.items()):
+            entries.append(ident.build_entry_report(
+                qual.rsplit(".", 1)[-1], entry, resolver, non_hash_fields))
+    else:
+        for name in registry_names:
+            rel = ENTRY_JIT.get(name)
+            if rel is not None:
+                qual = f"{graph.package}.{rel}"
+                entry = jit_entries.get(qual)
+                if entry is None:
+                    entries.append(_unmapped(graph, name,
+                                             f"jit function {qual} not "
+                                             f"found/not jit-decorated"))
+                    continue
+                notes = []
+                if name.endswith("_binary"):
+                    notes.append("binary-encoded variant: same jit "
+                                 "function, Kb-plane shapes")
+                entries.append(ident.build_entry_report(
+                    name, entry, resolver, non_hash_fields,
+                    shape_provenance=_SHAPE_PROVENANCE.get(name, ()),
+                    notes=notes))
+            elif name in _SYNTHETIC_ENTRIES:
+                suffix, prov, note = _SYNTHETIC_ENTRIES[name]
+                anchor = _find_suffix(graph, suffix)
+                if anchor is None:
+                    entries.append(_unmapped(graph, name,
+                                             f"anchor '{suffix}' not "
+                                             f"found in package"))
+                    continue
+                entries.append(ident.synthetic_entry_report(
+                    name, prov, non_hash_fields,
+                    graph.rel_path(anchor.path), anchor.line,
+                    notes=[note]))
+            else:
+                entries.append(_unmapped(graph, name,
+                                         "deep registry entry has no "
+                                         "identity mapping (extend "
+                                         "flow/engine.py ENTRY_JIT)"))
+    return {
+        "schema": ident.SCHEMA,
+        "package": graph.package,
+        "non_hash_fields": sorted(non_hash_fields),
+        "jit_cache_key_includes_jax_version": True,
+        "entries": entries,
+    }
+
+
+def _unmapped(graph: cg.PackageGraph, name: str, why: str) -> dict:
+    # an unmapped registry entry must gate (FL004), not vanish
+    init = graph.modules.get(f"{graph.package}")
+    path = graph.rel_path(init.path) if init else graph.package
+    return ident.synthetic_entry_report(
+        name, (f"unknown:{why}",), (), path, 1, notes=[why])
+
+
+def build_flow_context(package_root: Optional[pathlib.Path] = None,
+                       package: Optional[str] = None,
+                       registry_names: Optional[Sequence[str]] = "auto"
+                       ) -> FlowContext:
+    """Parse + summarise one package into the context the FL rules see.
+
+    ``registry_names='auto'`` (the real gate) reads the deep registry;
+    pass an explicit list, or None for fixture packages (every
+    discovered jit function becomes an identity entry).
+    """
+    root = pathlib.Path(package_root) if package_root is not None \
+        else DEFAULT_PACKAGE_ROOT
+    graph = cg.build_graph(root, package)
+    names = _registry_names() if registry_names == "auto" \
+        else registry_names
+    non_hash = non_hash_fields_of(graph)
+    jit_entries = ident.find_jit_functions(graph)
+    resolver = ident.ProvenanceResolver(graph)
+    report = build_identity_report(graph, resolver, jit_entries,
+                                   non_hash, names)
+    return FlowContext(graph=graph, non_hash_fields=non_hash,
+                       jit_entries=jit_entries, resolver=resolver,
+                       identity_report=report)
+
+
+def run_flow_rules(select: Optional[Set[str]] = None,
+                   package_root: Optional[pathlib.Path] = None,
+                   ctx: Optional[FlowContext] = None
+                   ) -> Tuple[List[Finding], FlowStats]:
+    """Build the graph and run the FL rules -> raw (unfiltered)
+    findings + stats.  Parse failures of package modules propagate as
+    findings-free stats with the errors recorded on the graph — the
+    gate surfaces them via the CLI's parse-error channel."""
+    rules = _flow_rules(select)
+    if not rules:
+        empty = {"schema": ident.SCHEMA, "package": "", "entries": [],
+                 "non_hash_fields": [],
+                 "jit_cache_key_includes_jax_version": True}
+        return [], FlowStats(modules=0, functions=0, collective_bearing=0,
+                             entries=[], verdicts={},
+                             identity_report=empty)
+    if ctx is None:
+        ctx = build_flow_context(package_root)
+    findings: List[Finding] = []
+    for rule in rules:
+        findings.extend(rule.check(ctx))
+    report = ctx.identity_report
+    stats = FlowStats(
+        modules=len(ctx.graph.modules),
+        functions=len(ctx.graph.functions),
+        collective_bearing=len(ctx.graph.collective_bearing),
+        entries=[e["name"] for e in report["entries"]],
+        verdicts={e["name"]: e["verdict"] for e in report["entries"]},
+        identity_report=report)
+    return findings, stats
+
+
+def _load_sources(findings: List[Finding]) -> Dict[str, List[str]]:
+    sources: Dict[str, List[str]] = {}
+    for f in findings:
+        if f.path in sources:
+            continue
+        p = pathlib.Path(f.path)
+        sources[f.path] = p.read_text().splitlines() if p.is_file() else []
+    return sources
+
+
+def _filter_suppressed(findings: List[Finding],
+                       sources: Dict[str, List[str]]
+                       ) -> Tuple[List[Finding], List[Finding]]:
+    kept: List[Finding] = []
+    dropped: List[Finding] = []
+    parsed: Dict[str, tuple] = {}
+    for f in findings:
+        if f.path not in parsed:
+            text = "\n".join(sources.get(f.path, []))
+            parsed[f.path] = suppress.parse_suppressions(text)
+        per_line, file_wide = parsed[f.path]
+        if suppress.is_suppressed(f.rule, f.line, per_line, file_wide):
+            dropped.append(f)
+        else:
+            kept.append(f)
+    return kept, dropped
+
+
+def flow_lint(select: Optional[Set[str]] = None,
+              baseline_path: Optional[pathlib.Path] = None,
+              package_root: Optional[pathlib.Path] = None
+              ) -> Tuple[LintResult, FlowStats,
+                         List[Tuple[Finding, str]]]:
+    """The flow gate -> (result, stats, fingerprinted findings).
+
+    Mirrors ``deep_lint``: the fingerprinted list covers ALL flow
+    findings so the CLI can fold them into ``--write-baseline`` /
+    ``--update-baseline`` against the one shared baseline file.
+    """
+    raw, stats = run_flow_rules(select, package_root)
+    sources = _load_sources(raw)
+    kept, suppressed = _filter_suppressed(raw, sources)
+    fingerprinted = baseline_mod.fingerprint_findings(kept, sources)
+
+    entries = baseline_mod.load_entries(baseline_path) if baseline_path \
+        else []
+    known = {e["fingerprint"] for e in entries}
+    new = [f for f, fp in fingerprinted if fp not in known]
+    baselined = [f for f, fp in fingerprinted if fp in known]
+
+    produced = {fp for _, fp in fingerprinted}
+    rule_ids = {r.id for r in _flow_rules(select)}
+    stale = {e["fingerprint"] for e in entries
+             if e["rule"] in rule_ids and e["fingerprint"] not in produced}
+    rationale = baseline_mod.rationales(entries)
+    matched = {fp for _, fp in fingerprinted if fp in known}
+    stats.unrationalized = sorted(
+        e["fingerprint"] for e in entries
+        if e["fingerprint"] in matched and e["fingerprint"] not in rationale)
+
+    result = LintResult(new=new, baselined=baselined,
+                        suppressed=suppressed, stale_baseline=stale,
+                        parse_errors=[], files_checked=len(sources))
+    return result, stats, fingerprinted

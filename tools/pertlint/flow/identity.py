@@ -1,0 +1,511 @@
+"""Program-identity provenance: config fields -> jit boundaries.
+
+A compiled program's identity is its static argnames, its input
+shapes/dtypes (here: the pad/bucket dims) and the jax version.  The
+persisted AOT executable cache (ROADMAP) wants to key programs by the
+config hash — which is only sound if
+
+* everything that reaches program identity is derivable from
+  hash-INCLUDED config fields (+ bucket dims + data shapes + the jax
+  version), and
+* no hash-EXCLUDED field (``config.NON_HASH_FIELDS``) ever reaches it.
+
+This module extracts, per jit entry point, the provenance of every
+identity input by walking the call graph backwards from the jit
+boundary: static kwargs at the call sites, dict-forwarded static
+environments (the ``_resolve_program(_run_fit, ..., static_kwargs)``
+idiom), parameter lifting through callers, ``self._attr`` resolution
+through ``__init__`` — all static, nothing imported.  The result feeds
+FL003/FL004 and serialises as ``artifacts/PROGRAM_IDENTITY.json``.
+
+Provenance atom vocabulary (strings in the report):
+
+* ``config:<field>``  — a PertConfig field read (hash-included unless
+  the field is in ``non_hash_fields``, which is a FL003 leak);
+  ``config:<method>()`` is a method ON the config object — a pure
+  derivation of hash-included fields (``cfg.resolved_iters()``)
+* ``literal`` / ``default`` — source constants
+* ``model-spec``      — the frozen PertModelSpec / loss structure
+  (itself built from hash-included fields + data dims)
+* ``bucket:<dim>``    — a serve-bucket dimension
+* ``data-shape``      — an input array's shape
+* ``jax-version``     — jax's own version (jit keys on it natively)
+* ``layout-contract`` — the sharding layout factory (DP006/DP007's
+  machine-checked contract)
+* ``api:<fn>:<param>``    — a caller-supplied public-API input with no
+  in-package binding (incomplete for cache-key purposes)
+* ``unknown:<what>``  — the analysis could not resolve it (incomplete)
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.pertlint.flow.callgraph import (
+    FunctionInfo,
+    PackageGraph,
+    dotted_name,
+)
+
+SCHEMA = "pert-program-identity/v1"
+
+_WRAPPERS = {"int", "float", "str", "bool", "min", "max", "len", "round",
+             "tuple", "abs", "sorted"}
+_SPEC_NAMES = {"spec", "loss_fn", "model_spec"}
+_BUCKET_ATTRS = {"cells", "loci"}
+_MAX_DEPTH = 10
+
+
+@dataclasses.dataclass
+class JitEntry:
+    """A jit-decorated package function and its declared identity."""
+    fn: FunctionInfo
+    static_argnames: Tuple[str, ...]
+    donate_argnames: Tuple[str, ...]
+    decorator_line: int
+
+
+def _tuple_of_strings(expr: ast.expr) -> Optional[Tuple[str, ...]]:
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out = []
+        for e in expr.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return (expr.value,)
+    return None
+
+
+def _names_operand(expr: ast.expr, graph: PackageGraph, module: str
+                   ) -> Tuple[str, ...]:
+    """Resolve a static/donate argnames expression: a literal tuple of
+    strings, or a Name bound to a module-level constant tuple (the
+    declared-contract idiom: ``FIT_STATIC_ARGNAMES``)."""
+    lit = _tuple_of_strings(expr)
+    if lit is not None:
+        return lit
+    if isinstance(expr, ast.Name):
+        const = graph.modules[module].constants.get(expr.id)
+        if const is not None:
+            return _tuple_of_strings(const) or ()
+    return ()
+
+
+def find_jit_functions(graph: PackageGraph) -> Dict[str, JitEntry]:
+    """qualname -> JitEntry for every jit-decorated package function.
+
+    Recognises ``@jax.jit``, ``@jit``, and
+    ``@functools.partial(jax.jit, static_argnames=..., ...)``.
+    """
+    out: Dict[str, JitEntry] = {}
+    for fn in graph.functions.values():
+        for dec in getattr(fn.node, "decorator_list", []):
+            entry = _jit_from_decorator(dec, graph, fn)
+            if entry is not None:
+                out[fn.qualname] = entry
+                break
+    return out
+
+
+def _jit_from_decorator(dec: ast.expr, graph: PackageGraph,
+                        fn: FunctionInfo) -> Optional[JitEntry]:
+    raw = dotted_name(dec if not isinstance(dec, ast.Call) else dec.func)
+    statics: Tuple[str, ...] = ()
+    donates: Tuple[str, ...] = ()
+    is_jit = False
+    if raw and raw.endswith("jit") and not isinstance(dec, ast.Call):
+        is_jit = True
+    elif isinstance(dec, ast.Call):
+        if raw and raw.endswith("jit"):
+            is_jit = True
+            kwargs = dec.keywords
+        elif raw and raw.endswith("partial") and dec.args and \
+                (dotted_name(dec.args[0]) or "").endswith("jit"):
+            is_jit = True
+            kwargs = dec.keywords
+        else:
+            kwargs = []
+        for kw in kwargs:
+            if kw.arg == "static_argnames":
+                statics = _names_operand(kw.value, graph, fn.module)
+            elif kw.arg == "donate_argnames":
+                donates = _names_operand(kw.value, graph, fn.module)
+    if not is_jit:
+        return None
+    return JitEntry(fn=fn, static_argnames=statics,
+                    donate_argnames=donates, decorator_line=dec.lineno)
+
+
+class ProvenanceResolver:
+    """Backward dataflow from an expression to its provenance atoms."""
+
+    def __init__(self, graph: PackageGraph):
+        self.graph = graph
+        self._callers: Optional[Dict[str, List[Tuple[FunctionInfo,
+                                                     ast.Call]]]] = None
+
+    # -- call-site index --------------------------------------------------
+
+    def callers_of(self, qualname: str
+                   ) -> List[Tuple[FunctionInfo, ast.Call]]:
+        if self._callers is None:
+            self._callers = {}
+            for fn in self.graph.functions.values():
+                for site in fn.calls:
+                    if site.resolved:
+                        self._callers.setdefault(site.resolved, []).append(
+                            (fn, site.node))
+        return self._callers.get(qualname, [])
+
+    def reference_sites(self, qualname: str
+                        ) -> List[Tuple[FunctionInfo, ast.Call]]:
+        """Calls that pass ``qualname``'s function AS AN ARGUMENT (the
+        forwarding idiom: ``_resolve_program(_run_fit, ...)``)."""
+        out = []
+        for fn in self.graph.functions.values():
+            for site in fn.calls:
+                for arg in list(site.node.args) + \
+                        [k.value for k in site.node.keywords]:
+                    raw = dotted_name(arg)
+                    if raw and self.graph.resolve_call(raw, fn) == qualname:
+                        out.append((fn, site.node))
+                        break
+        return out
+
+    # -- expression atoms -------------------------------------------------
+
+    def atoms(self, expr: ast.expr, fn: Optional[FunctionInfo],
+              depth: int = 0,
+              seen: Optional[Set[Tuple[str, str]]] = None) -> Set[str]:
+        seen = seen if seen is not None else set()
+        if depth > _MAX_DEPTH:
+            return {"unknown:depth-limit"}
+        if isinstance(expr, ast.Constant):
+            return {"literal"}
+        if isinstance(expr, ast.Name):
+            return self._name_atoms(expr.id, fn, depth, seen)
+        if isinstance(expr, ast.Attribute):
+            return self._attr_atoms(expr, fn, depth, seen)
+        if isinstance(expr, ast.Call):
+            return self._call_atoms(expr, fn, depth, seen)
+        if isinstance(expr, (ast.BinOp, ast.BoolOp, ast.Compare,
+                             ast.IfExp, ast.UnaryOp)):
+            out: Set[str] = set()
+            for c in ast.iter_child_nodes(expr):
+                if isinstance(c, ast.expr):
+                    out |= self.atoms(c, fn, depth + 1, seen)
+            return out or {"literal"}
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            out = set()
+            for e in expr.elts:
+                out |= self.atoms(e, fn, depth + 1, seen)
+            return out or {"literal"}
+        if isinstance(expr, ast.Dict):
+            out = set()
+            for v in expr.values:
+                out |= self.atoms(v, fn, depth + 1, seen)
+            return out or {"literal"}
+        if isinstance(expr, ast.Subscript):
+            return self.atoms(expr.value, fn, depth + 1, seen)
+        if isinstance(expr, (ast.Lambda,)):
+            return {"model-spec"}
+        try:
+            desc = ast.unparse(expr)
+        except Exception:  # noqa: BLE001 — display only
+            desc = type(expr).__name__
+        return {f"unknown:{desc[:40]}"}
+
+    def _name_atoms(self, name: str, fn: Optional[FunctionInfo],
+                    depth: int, seen: Set[Tuple[str, str]]) -> Set[str]:
+        if name in _SPEC_NAMES:
+            return {"model-spec"}
+        if name in ("pad_cells_to", "pad_loci_to", "cell_chunk"):
+            # the bucket dims by their canonical knob names — they are
+            # ALSO hash-included config fields; tag both facets
+            return {f"config:{name}"}
+        scope = fn
+        while scope is not None:
+            # params/locals of this function, then of each enclosing
+            # function (free variables in a closure read outer scope)
+            if name in scope.params:
+                return self._param_atoms(scope, name, depth, seen)
+            assigns = self._local_assigns(scope, name)
+            if assigns:
+                out: Set[str] = set()
+                for value in assigns:
+                    out |= self.atoms(value, scope, depth + 1, seen)
+                return out
+            scope = self.graph.functions.get(scope.parent) \
+                if scope.parent else None
+        if fn is not None:
+            const = self.graph.modules[fn.module].constants.get(name)
+            if const is not None:
+                return self.atoms(const, None, depth + 1, seen)
+        return {f"unknown:{name}"}
+
+    def _attr_atoms(self, expr: ast.Attribute, fn: Optional[FunctionInfo],
+                    depth: int, seen: Set[Tuple[str, str]]) -> Set[str]:
+        base = dotted_name(expr.value)
+        if base and _is_config_base(base):
+            return {f"config:{expr.attr}"}
+        if expr.attr == "shape" or (base and base.endswith(".shape")):
+            return {"data-shape"}
+        if expr.attr == "__version__":
+            return {"jax-version"}
+        if base == "bucket" and expr.attr in _BUCKET_ATTRS:
+            return {f"bucket:{expr.attr}"}
+        if base == "self" and fn is not None and fn.cls:
+            assigns = self.graph.modules[fn.module].class_attrs.get(
+                (fn.cls, expr.attr), [])
+            if assigns:
+                out: Set[str] = set()
+                for value in assigns:
+                    # evaluated without local scope: config-reads and
+                    # constants still resolve, locals degrade to unknown
+                    out |= self.atoms(value, None, depth + 1, seen)
+                return out
+        try:
+            desc = ast.unparse(expr)
+        except Exception:  # noqa: BLE001
+            desc = expr.attr
+        return {f"unknown:{desc[:40]}"}
+
+    def _call_atoms(self, expr: ast.Call, fn: Optional[FunctionInfo],
+                    depth: int, seen: Set[Tuple[str, str]]) -> Set[str]:
+        raw = dotted_name(expr.func) or ""
+        last = raw.rsplit(".", 1)[-1]
+        args = list(expr.args) + [k.value for k in expr.keywords]
+        base = raw.rsplit(".", 1)[0] if "." in raw else ""
+        if base and _is_config_base(base):
+            # a method ON the config object (cfg.resolved_iters()):
+            # the value is a pure derivation of hash-included fields
+            return {f"config:{last}()"}
+        if last in _WRAPPERS or last in ("resolve_fused_adam",
+                                         "moment_jnp_dtype"):
+            out: Set[str] = set()
+            for a in args:
+                out |= self.atoms(a, fn, depth + 1, seen)
+            return out or {"literal"}
+        if last and (last[0].isupper() or last.startswith("_Pert")):
+            # constructor: the structure is its (resolved) arguments
+            out = set()
+            for a in args:
+                out |= self.atoms(a, fn, depth + 1, seen)
+            return out or {"model-spec"}
+        if not args:
+            return {f"unknown:{raw or 'call'}()"}
+        out = set()
+        for a in args:
+            out |= self.atoms(a, fn, depth + 1, seen)
+        return out
+
+    def _local_assigns(self, fn: FunctionInfo, name: str
+                       ) -> List[ast.expr]:
+        out = []
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == name:
+                        out.append(node.value)
+            elif isinstance(node, ast.AnnAssign) and node.value and \
+                    isinstance(node.target, ast.Name) and \
+                    node.target.id == name:
+                out.append(node.value)
+        return out
+
+    def _param_atoms(self, fn: FunctionInfo, param: str, depth: int,
+                     seen: Set[Tuple[str, str]]) -> Set[str]:
+        key = (fn.qualname, param)
+        if key in seen:
+            return set()
+        seen = seen | {key}
+        default = self._param_default(fn, param)
+        bindings = []
+        for caller, call in self.callers_of(fn.qualname):
+            bound = self._bind_param(fn, param, call)
+            if bound is not None:
+                bindings.append((caller, bound))
+        out: Set[str] = set()
+        for caller, bound in bindings:
+            out |= self.atoms(bound, caller, depth + 1, seen)
+        if not bindings:
+            out |= ({"default"} if default is not None
+                    else {f"api:{fn.qualname.rsplit('.', 1)[-1]}:{param}"})
+        elif default is not None:
+            # some call sites may omit it: the default is reachable too
+            out |= {"default"}
+        return out
+
+    def _param_default(self, fn: FunctionInfo, param: str
+                       ) -> Optional[ast.expr]:
+        a = fn.node.args
+        pos = a.posonlyargs + a.args
+        n_def = len(a.defaults)
+        for i, p in enumerate(pos):
+            if p.arg == param:
+                j = i - (len(pos) - n_def)
+                return a.defaults[j] if j >= 0 else None
+        for i, p in enumerate(a.kwonlyargs):
+            if p.arg == param:
+                return a.kw_defaults[i]
+        return None
+
+    def _bind_param(self, fn: FunctionInfo, param: str, call: ast.Call
+                    ) -> Optional[ast.expr]:
+        for kw in call.keywords:
+            if kw.arg == param:
+                return kw.value
+        params = list(fn.params)
+        if params and params[0] in ("self", "cls"):
+            params = params[1:]     # bound-method call convention
+        try:
+            idx = params.index(param)
+        except ValueError:
+            return None
+        if idx < len(call.args):
+            arg = call.args[idx]
+            return None if isinstance(arg, ast.Starred) else arg
+        return None
+
+    # -- static-argname provenance ---------------------------------------
+
+    def static_provenance(self, entry: JitEntry
+                          ) -> Dict[str, Set[str]]:
+        """static argname -> provenance atoms, unioned over every
+        direct call site and every dict-forwarding site."""
+        fn = entry.fn
+        out: Dict[str, Set[str]] = {s: set() for s in entry.static_argnames}
+        for caller, call in self.callers_of(fn.qualname):
+            for s in entry.static_argnames:
+                bound = self._bind_param(fn, s, call)
+                if bound is not None:
+                    out[s] |= self.atoms(bound, caller, 1)
+        for caller, call in self.reference_sites(fn.qualname):
+            env = self._dict_env(caller, entry.static_argnames)
+            names_in_call = {dotted_name(a) for a in call.args} | \
+                {dotted_name(k.value) for k in call.keywords}
+            for s in entry.static_argnames:
+                if s in env:
+                    out[s] |= self.atoms(env[s], caller, 1)
+                elif s in names_in_call:
+                    out[s] |= self._name_atoms(s, caller, 1, set())
+        for s in entry.static_argnames:
+            if not out[s]:
+                d = self._param_default(fn, s)
+                out[s] = {"default"} if d is not None else \
+                    {f"api:{fn.qualname.rsplit('.', 1)[-1]}:{s}"}
+        return out
+
+    def _dict_env(self, fn: FunctionInfo, keys: Sequence[str]
+                  ) -> Dict[str, ast.expr]:
+        """Locals assigned ``dict(k=v, ...)`` / ``{...}`` whose keys
+        overlap the static argnames — the forwarded static env."""
+        env: Dict[str, ast.expr] = {}
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            v = node.value
+            pairs: List[Tuple[str, ast.expr]] = []
+            if isinstance(v, ast.Call) and \
+                    (dotted_name(v.func) or "") == "dict":
+                pairs = [(kw.arg, kw.value) for kw in v.keywords if kw.arg]
+            elif isinstance(v, ast.Dict):
+                pairs = [(k.value, val) for k, val in zip(v.keys, v.values)
+                         if isinstance(k, ast.Constant)
+                         and isinstance(k.value, str)]
+            matched = {k: val for k, val in pairs if k in keys}
+            if matched:
+                env.update(matched)
+        return env
+
+
+def _is_config_base(base: str) -> bool:
+    return (base in ("config", "cfg")
+            or base.endswith(".config") or base.endswith(".cfg"))
+
+
+# ---------------------------------------------------------------------------
+# the report
+# ---------------------------------------------------------------------------
+
+def classify(atom: str, non_hash_fields: Sequence[str]) -> str:
+    """covered | leak | incomplete for one provenance atom."""
+    if atom.startswith("config:"):
+        return "leak" if atom.split(":", 1)[1] in non_hash_fields \
+            else "covered"
+    if atom.startswith(("unknown:", "api:")):
+        return "incomplete"
+    return "covered"
+
+
+def entry_verdict(inputs: Dict[str, Set[str]],
+                  non_hash_fields: Sequence[str]) -> str:
+    kinds = {classify(a, non_hash_fields)
+             for atoms in inputs.values() for a in atoms}
+    if "leak" in kinds:
+        return "leak"
+    if "incomplete" in kinds:
+        return "incomplete"
+    return "covered"
+
+
+def build_entry_report(name: str, entry: JitEntry,
+                       resolver: ProvenanceResolver,
+                       non_hash_fields: Sequence[str],
+                       shape_provenance: Sequence[str] = (),
+                       notes: Sequence[str] = ()) -> dict:
+    prov = resolver.static_provenance(entry)
+    inputs = dict(prov)
+    if shape_provenance:
+        inputs["<dynamic arg shapes+dtypes>"] = set(shape_provenance)
+    return {
+        "name": name,
+        "jit_function": entry.fn.qualname,
+        "path": resolver.graph.rel_path(entry.fn.path),
+        "line": entry.fn.line,
+        "static_argnames": list(entry.static_argnames),
+        "donate_argnames": list(entry.donate_argnames),
+        "identity_inputs": [
+            {"name": k,
+             "provenance": sorted(v),
+             "classification": _worst(v, non_hash_fields)}
+            for k, v in inputs.items()],
+        "verdict": entry_verdict(inputs, non_hash_fields),
+        "notes": list(notes),
+    }
+
+
+def _worst(atoms: Set[str], non_hash_fields: Sequence[str]) -> str:
+    kinds = {classify(a, non_hash_fields) for a in atoms}
+    for k in ("leak", "incomplete"):
+        if k in kinds:
+            return k
+    return "covered"
+
+
+def synthetic_entry_report(name: str, provenance: Sequence[str],
+                           non_hash_fields: Sequence[str],
+                           anchor_path: str, anchor_line: int,
+                           notes: Sequence[str] = ()) -> dict:
+    """Report row for an entry whose identity is not a jit decoration
+    (the loss structure, the shard_map placement factories)."""
+    atoms = set(provenance)
+    return {
+        "name": name,
+        "jit_function": None,
+        "path": anchor_path,
+        "line": anchor_line,
+        "static_argnames": [],
+        "donate_argnames": [],
+        "identity_inputs": [
+            {"name": "<structural identity>",
+             "provenance": sorted(atoms),
+             "classification": _worst(atoms, non_hash_fields)}],
+        "verdict": entry_verdict({"_": atoms}, non_hash_fields),
+        "notes": list(notes),
+    }

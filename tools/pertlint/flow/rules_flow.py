@@ -1,0 +1,443 @@
+"""The FL rules: SPMD discipline + program identity over the call graph.
+
+Each rule's ``check`` receives a :class:`FlowContext` — the parsed
+package graph, the hash-exclusion contract, the jit entries and the
+program-identity report — and yields findings anchored at real source
+lines, so pertlint's inline suppression and content-addressed baseline
+apply to the flow layer unchanged.
+
+SPMD family (the PR-11 deadlock classes, machine-checked):
+
+* FL001 — a collective is reachable only under rank-divergent control
+  flow: an ``if jax.process_index() == 0:`` branch, the shadow of a
+  rank-guarded early return, or a per-rank ``except`` arm.  Every
+  process must enter every collective or the others hang forever.
+* FL002 — two branches of one conditional issue collectives in
+  different sequences; unless the condition is provably count-uniform,
+  ranks can disagree on the branch and the collectives cross-match.
+* FL006 (warning) — host-side ``np.asarray``-style fetch of array
+  values on a path that runs under >1 processes: each host sees only
+  its addressable shards, so the fetch silently computes on a fraction
+  of the data.  The inventory is the work list for mesh-native
+  decode/QC; it reports but never gates.
+
+Program-identity family (the AOT-cache-key soundness certificate):
+
+* FL003 — a hash-EXCLUDED config field (``config.NON_HASH_FIELDS``)
+  reaches program identity: a static argname, a pad/shape/bucket
+  computation, or a dtype choice.  Two configs that hash equal would
+  compile different programs — the cache would serve the wrong one.
+* FL004 — an identity input of a jit entry point is NOT derivable from
+  hash-included config fields + bucket dims + data shapes + the jax
+  version: the config hash under-determines the program, so equal
+  hashes do not imply equal executables.
+* FL005 — retrace hazard at a jit call site: an unhashable container
+  literal fed to a static argname (every call raises or retraces), or
+  a bare weak-typed Python scalar fed to a dynamic argument (its weak
+  dtype makes a second trace for an otherwise-identical call).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.pertlint.core import Finding, Rule, register
+from tools.pertlint.flow import callgraph as cg
+from tools.pertlint.flow import identity as ident
+
+# kwarg names whose value becomes an array shape/padding/dtype — the
+# non-static-argname ways a value can reach program identity
+SHAPE_SINK_KWARGS = {"pad_cells_to", "pad_loci_to", "pad_to", "shape",
+                     "dtype", "moment_dtype", "optimizer_state_dtype"}
+SHAPE_SINK_CALLEES = {"astype", "reshape", "pad_cells", "pad_loci",
+                      "select_bucket"}
+
+
+@dataclasses.dataclass
+class FlowContext:
+    """Everything the FL rules see; built once per run by the engine."""
+    graph: cg.PackageGraph
+    non_hash_fields: Tuple[str, ...]
+    jit_entries: Dict[str, ident.JitEntry]
+    resolver: ident.ProvenanceResolver
+    identity_report: dict        # the PROGRAM_IDENTITY.json payload
+
+
+class FlowRule(Rule):
+    kind = "flow"
+    context = "flow"
+
+    def _finding(self, ctx: FlowContext, path: str, node,
+                 message: str) -> Finding:
+        return Finding(rule=self.id, severity=self.severity,
+                       path=ctx.graph.rel_path(path),
+                       line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0), message=message)
+
+
+def _divergent_guard(guards: Tuple[cg.Guard, ...]
+                     ) -> Optional[cg.Guard]:
+    """The first guard frame that makes reachability rank-divergent."""
+    for g in guards:
+        if g.taint == cg.RANK and g.kind in ("if", "else", "after-return"):
+            return g
+        if g.kind == "except":
+            return g
+    return None
+
+
+@register
+class RankGuardedCollective(FlowRule):
+    id = "FL001"
+    name = "rank-guarded-collective"
+    severity = "error"
+    description = ("collective (barrier/sync_global_devices/allgather or "
+                   "a function that reaches one) under rank-divergent "
+                   "control flow — the unguarded ranks hang forever")
+
+    def check(self, ctx: FlowContext) -> Iterable[Finding]:
+        for fn in ctx.graph.functions.values():
+            for site in ctx.graph.collective_sites(fn):
+                g = _divergent_guard(site.guards)
+                if g is None:
+                    continue
+                what = site.resolved or site.raw
+                if g.kind == "except":
+                    how = (f"inside the per-rank 'except {g.test_text}' "
+                           f"arm at line {g.line} — exceptions are "
+                           f"rank-local, so only the failing rank enters")
+                elif g.kind == "after-return":
+                    how = (f"after the rank-guarded early return at line "
+                           f"{g.line} ('{g.test_text}') — the returning "
+                           f"rank never arrives")
+                else:
+                    how = (f"under the rank-dependent '{g.test_text}' "
+                           f"branch at line {g.line}")
+                yield self._finding(
+                    ctx, fn.path, site.node,
+                    f"collective '{what}' in {fn.qualname} is reachable "
+                    f"only {how}; every process must enter every "
+                    f"collective (guard on jax.process_count(), which is "
+                    f"SPMD-uniform, or restructure so all ranks call it)")
+
+
+def _collective_sequence(ctx: FlowContext, fn: cg.FunctionInfo,
+                         stmts: List[ast.stmt]) -> List[str]:
+    """In-order collective tokens issued by a statement list."""
+    by_node = {id(s.node): s for s in ctx.graph.collective_sites(fn)}
+    out: List[Tuple[int, int, str]] = []
+    for s in stmts:
+        for sub in ast.walk(s):
+            hit = by_node.get(id(sub))
+            if hit is not None:
+                out.append((sub.lineno, sub.col_offset,
+                            hit.resolved or hit.raw))
+    out.sort()
+    return [t for _, _, t in out]
+
+
+@register
+class CollectiveOrderDivergence(FlowRule):
+    id = "FL002"
+    name = "collective-order-divergence"
+    severity = "error"
+    description = ("two branches of one conditional issue collectives in "
+                   "different sequences — ranks that disagree on the "
+                   "branch cross-match collectives and deadlock")
+
+    def check(self, ctx: FlowContext) -> Iterable[Finding]:
+        for fn in ctx.graph.functions.values():
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.If) or not node.orelse:
+                    continue
+                if ctx.graph.expr_taint(node.test, fn) == cg.COUNT:
+                    continue    # count-uniform: all ranks take one branch
+                a = _collective_sequence(ctx, fn, node.body)
+                b = _collective_sequence(ctx, fn, node.orelse)
+                if a and b and a != b:
+                    yield self._finding(
+                        ctx, fn.path, node,
+                        f"branches of 'if {_text(node.test)}' in "
+                        f"{fn.qualname} issue different collective "
+                        f"sequences ({' -> '.join(a)} vs "
+                        f"{' -> '.join(b)}); unless every rank takes the "
+                        f"same branch these cross-match and deadlock")
+
+
+def _text(node) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # noqa: BLE001 — display only
+        return "<expr>"
+
+
+def _excluded_reads(expr: ast.expr, fn: cg.FunctionInfo,
+                    tainted: Dict[str, Set[str]],
+                    non_hash: Tuple[str, ...]) -> Set[str]:
+    """Excluded config fields whose value the expression carries."""
+    fields: Set[str] = set()
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Attribute):
+            base = cg.dotted_name(sub.value)
+            if base and ident._is_config_base(base) \
+                    and sub.attr in non_hash:
+                fields.add(sub.attr)
+        elif isinstance(sub, ast.Name) and sub.id in tainted:
+            fields |= tainted[sub.id]
+    return fields
+
+
+@register
+class ExcludedFieldReachesIdentity(FlowRule):
+    id = "FL003"
+    name = "excluded-field-identity-leak"
+    severity = "error"
+    description = ("hash-excluded config field (NON_HASH_FIELDS) flows "
+                   "into program identity (static argname, shape/pad/"
+                   "bucket, or dtype) — equal config hashes would compile "
+                   "different programs")
+
+    def check(self, ctx: FlowContext) -> Iterable[Finding]:
+        # (a) the per-entry-point certificate: leaks visible in the
+        # static-argname provenance of any registered jit entry
+        for entry in ctx.identity_report.get("entries", []):
+            for inp in entry["identity_inputs"]:
+                leaked = [a.split(":", 1)[1] for a in inp["provenance"]
+                          if a.startswith("config:")
+                          and a.split(":", 1)[1] in ctx.non_hash_fields]
+                if leaked:
+                    yield Finding(
+                        rule=self.id, severity=self.severity,
+                        path=entry["path"], line=entry["line"], col=0,
+                        message=(f"[{entry['name']}] hash-excluded field"
+                                 f"(s) {sorted(set(leaked))} reach "
+                                 f"identity input '{inp['name']}' — "
+                                 f"remove the field from program "
+                                 f"identity or from NON_HASH_FIELDS"))
+        # (b) the interprocedural sink scan: pad/shape/dtype sinks and
+        # jit static args anywhere in the package
+        taint_map = _propagate_excluded(ctx)
+        for fn in ctx.graph.functions.values():
+            tainted = _local_excluded(ctx, fn, taint_map)
+            yield from self._sink_scan(ctx, fn, tainted)
+
+    def _sink_scan(self, ctx: FlowContext, fn: cg.FunctionInfo,
+                   tainted: Dict[str, Set[str]]) -> Iterable[Finding]:
+        for site in fn.calls:
+            entry = ctx.jit_entries.get(site.resolved or "")
+            if entry is not None:
+                for s in entry.static_argnames:
+                    bound = ctx.resolver._bind_param(entry.fn, s, site.node)
+                    if bound is None:
+                        continue
+                    fields = _excluded_reads(bound, fn, tainted,
+                                             ctx.non_hash_fields)
+                    if fields:
+                        yield self._finding(
+                            ctx, fn.path, site.node,
+                            f"hash-excluded field(s) {sorted(fields)} "
+                            f"feed static argname '{s}' of jit entry "
+                            f"{entry.fn.qualname} — retrace/cache key "
+                            f"now depends on an identity-excluded value")
+            last = site.raw.rsplit(".", 1)[-1]
+            for kw in site.node.keywords:
+                if kw.arg in SHAPE_SINK_KWARGS or \
+                        (last in SHAPE_SINK_CALLEES and kw.arg):
+                    fields = _excluded_reads(kw.value, fn, tainted,
+                                             ctx.non_hash_fields)
+                    if fields:
+                        yield self._finding(
+                            ctx, fn.path, site.node,
+                            f"hash-excluded field(s) {sorted(fields)} "
+                            f"reach shape/dtype argument "
+                            f"'{kw.arg}' of {site.raw} — program "
+                            f"identity depends on an excluded value")
+            if last in ("astype", "reshape") and site.node.args:
+                fields = _excluded_reads(site.node.args[0], fn, tainted,
+                                         ctx.non_hash_fields)
+                if fields:
+                    yield self._finding(
+                        ctx, fn.path, site.node,
+                        f"hash-excluded field(s) {sorted(fields)} reach "
+                        f"'{site.raw}' — shape/dtype identity depends "
+                        f"on an excluded value")
+
+
+def _local_excluded(ctx: FlowContext, fn: cg.FunctionInfo,
+                    taint_map: Dict[str, Dict[str, Set[str]]]
+                    ) -> Dict[str, Set[str]]:
+    """name -> excluded fields it carries, within one function."""
+    tainted: Dict[str, Set[str]] = {
+        p: set(fields) for p, fields in
+        taint_map.get(fn.qualname, {}).items()}
+    for _ in range(2):
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Assign):
+                fields = _excluded_reads(node.value, fn, tainted,
+                                         ctx.non_hash_fields)
+                if not fields:
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        tainted.setdefault(tgt.id, set()).update(fields)
+    return tainted
+
+
+def _propagate_excluded(ctx: FlowContext
+                        ) -> Dict[str, Dict[str, Set[str]]]:
+    """Fixpoint: excluded-field taint carried into callee parameters."""
+    taint_map: Dict[str, Dict[str, Set[str]]] = {}
+    for _ in range(6):
+        changed = False
+        for fn in ctx.graph.functions.values():
+            tainted = _local_excluded(ctx, fn, taint_map)
+            for site in fn.calls:
+                callee = ctx.graph.functions.get(site.resolved or "")
+                if callee is None:
+                    continue
+                for kw in site.node.keywords:
+                    fields = _excluded_reads(kw.value, fn, tainted,
+                                             ctx.non_hash_fields)
+                    if fields and kw.arg:
+                        cur = taint_map.setdefault(
+                            callee.qualname, {}).setdefault(kw.arg, set())
+                        if not fields <= cur:
+                            cur |= fields
+                            changed = True
+                params = list(callee.params)
+                if params and params[0] in ("self", "cls"):
+                    params = params[1:]
+                for i, arg in enumerate(site.node.args):
+                    if i >= len(params) or isinstance(arg, ast.Starred):
+                        continue
+                    fields = _excluded_reads(arg, fn, tainted,
+                                             ctx.non_hash_fields)
+                    if fields:
+                        cur = taint_map.setdefault(
+                            callee.qualname, {}).setdefault(
+                                params[i], set())
+                        if not fields <= cur:
+                            cur |= fields
+                            changed = True
+        if not changed:
+            break
+    return taint_map
+
+
+@register
+class CacheKeyIncomplete(FlowRule):
+    id = "FL004"
+    name = "cache-key-incomplete"
+    severity = "error"
+    description = ("identity input of a registered jit entry point is "
+                   "not derivable from hash-included config fields + "
+                   "bucket dims + jax version — equal config hashes "
+                   "would not imply equal executables")
+
+    def check(self, ctx: FlowContext) -> Iterable[Finding]:
+        for entry in ctx.identity_report.get("entries", []):
+            bad = [(inp["name"],
+                    [a for a in inp["provenance"]
+                     if a.startswith(("unknown:", "api:"))])
+                   for inp in entry["identity_inputs"]
+                   if inp["classification"] == "incomplete"]
+            if not bad:
+                continue
+            detail = "; ".join(f"'{n}' <- {', '.join(a)}" for n, a in bad)
+            yield Finding(
+                rule=self.id, severity=self.severity,
+                path=entry["path"], line=entry["line"], col=0,
+                message=(f"[{entry['name']}] identity input(s) with "
+                         f"unresolvable provenance: {detail} — the "
+                         f"config hash under-determines this program's "
+                         f"identity (declare the source or route it "
+                         f"through a hash-included field)"))
+
+
+@register
+class RetraceHazard(FlowRule):
+    id = "FL005"
+    name = "retrace-hazard"
+    severity = "error"
+    description = ("jit call site feeds an unhashable container literal "
+                   "to a static argname, or a bare weak-typed Python "
+                   "scalar to a dynamic argument — each call retraces "
+                   "(or raises) instead of reusing the compiled program")
+
+    def check(self, ctx: FlowContext) -> Iterable[Finding]:
+        for fn in ctx.graph.functions.values():
+            for site in fn.calls:
+                entry = ctx.jit_entries.get(site.resolved or "")
+                if entry is None:
+                    continue
+                yield from self._site(ctx, fn, site, entry)
+
+    def _site(self, ctx: FlowContext, fn: cg.FunctionInfo,
+              site: cg.CallSite, entry: ident.JitEntry
+              ) -> Iterable[Finding]:
+        params = list(entry.fn.params)
+        if params and params[0] in ("self", "cls"):
+            params = params[1:]
+        statics = set(entry.static_argnames)
+        bound: List[Tuple[str, ast.expr]] = []
+        for i, arg in enumerate(site.node.args):
+            if i < len(params) and not isinstance(arg, ast.Starred):
+                bound.append((params[i], arg))
+        for kw in site.node.keywords:
+            if kw.arg:
+                bound.append((kw.arg, kw.value))
+        for name, value in bound:
+            if name in statics:
+                if isinstance(value, (ast.List, ast.Dict, ast.Set,
+                                      ast.ListComp, ast.DictComp,
+                                      ast.SetComp)):
+                    yield self._finding(
+                        ctx, fn.path, value,
+                        f"unhashable {type(value).__name__} literal fed "
+                        f"to static argname '{name}' of "
+                        f"{entry.fn.qualname} — statics must be "
+                        f"hashable by value (use a tuple or a frozen "
+                        f"dataclass)")
+            else:
+                weak = (isinstance(value, ast.Constant)
+                        and isinstance(value.value, (int, float))
+                        and not isinstance(value.value, bool))
+                weak = weak or (
+                    isinstance(value, ast.Call)
+                    and (cg.dotted_name(value.func) or "") in
+                    ("int", "float"))
+                if weak:
+                    yield self._finding(
+                        ctx, fn.path, value,
+                        f"weak-typed Python scalar fed to dynamic "
+                        f"argument '{name}' of {entry.fn.qualname} — "
+                        f"pin the dtype (jnp.asarray(..., dtype=...)) "
+                        f"or the weak dtype forces a second trace")
+
+
+@register
+class HostFetchOnMultiprocessPath(FlowRule):
+    id = "FL006"
+    name = "host-global-fetch"
+    severity = "warning"
+    description = ("host-side np.asarray/device_get of array values on a "
+                   "multi-process-reachable path — each host sees only "
+                   "its addressable shards (work list for mesh-native "
+                   "decode/QC; reports, never gates)")
+
+    def check(self, ctx: FlowContext) -> Iterable[Finding]:
+        for fn in ctx.graph.functions.values():
+            if fn.qualname not in ctx.graph.multiprocess_reachable:
+                continue
+            for site in ctx.graph.host_fetch_sites(fn):
+                if any(g.count_world == "single" for g in site.guards):
+                    continue    # provably single-process branch
+                yield self._finding(
+                    ctx, fn.path, site.node,
+                    f"host fetch '{site.raw}' in {fn.qualname} runs on "
+                    f"a multi-process-reachable path; with >1 processes "
+                    f"it materialises only this host's addressable "
+                    f"shards (guard with process_count()==1, or move "
+                    f"the consumer onto the mesh)")

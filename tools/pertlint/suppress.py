@@ -10,9 +10,10 @@ Comments are found with :mod:`tokenize` rather than a substring scan so
 a string literal containing the marker text can never suppress anything.
 
 Malformed markers fail CLOSED: a typo'd keyword (``disable-files=``) or
-a rule list with no valid ``PLnnn`` ids suppresses nothing — a silent
+a rule list with no valid rule id suppresses nothing — a silent
 widen-to-everything here would turn a typo into a disabled CI gate.
-Rule ids are case-normalised (``pl005`` works).
+Valid ids cover all three layers (``PLnnn`` ast, ``DPnnn`` deep,
+``FLnnn`` flow) and are case-normalised (``pl005`` works).
 """
 
 from __future__ import annotations
@@ -27,7 +28,7 @@ from typing import Dict, Set, Tuple
 _MARKER = re.compile(
     r"#\s*pertlint:\s*(?P<kind>disable(?:-file)?)(?=[\s=]|$)"
     r"(?:\s*=\s*(?P<rules>[A-Za-z0-9_,\s]+))?")
-_RULE_ID = re.compile(r"PL\d{3}$")
+_RULE_ID = re.compile(r"(?:PL|DP|FL)\d{3}$")
 
 ALL = "*"
 
