@@ -424,7 +424,19 @@ def _parse_args(argv=None):
                     help="also record the LAST warm request's run log "
                          "as a pert_fleet regression baseline (the "
                          "compile-cache residency gate CI holds serve "
-                         "traffic against)")
+                         "traffic against); with --depth, the record "
+                         "comes from the BATCHED arm")
+    ap.add_argument("--depth", type=int, default=None,
+                    help="with --serve-ab: burst mode — submit this "
+                         "many requests upfront (mixed buckets) and "
+                         "compare a strictly serial worker "
+                         "(max_batch=1) against a continuously "
+                         "batched one (--serve-max-batch); latency is "
+                         "queue wait + service wall, the regime where "
+                         "batching collapses p99 toward p50")
+    ap.add_argument("--serve-max-batch", type=int, default=4,
+                    help="slab width K of the batched burst arm "
+                         "(--depth)")
     ap.add_argument("--enum-ab", action="store_true",
                     help="run the CN-encoding A/B instead of the SVI "
                          "microbench: the step-2 fit (production "
@@ -938,6 +950,219 @@ def _serve_ab_warm_arm(cohorts, options, workdir, args):
     }
 
 
+def _serve_burst_workload(args):
+    """``--depth`` N burst cohorts, MIXED buckets: three of every four
+    requests at the base genome length, every fourth at half length —
+    the halves land one loci-bucket rung below, so the burst exercises
+    the batched worker's same-rung claim steering (off-rung tickets
+    wait for the slab to drain or a rung switch) instead of a
+    trivially uniform slab.  The mix rides LOCI rather than cohort
+    size so every request stays in the small-cells regime — per-lane
+    matrices that leave the host's SIMD lanes headroom for the slab to
+    vectorize into, the many-small-concurrent-requests shape
+    continuous batching exists for."""
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent
+                           / "tools"))
+    from accuracy_sweep import _tutorial
+
+    tut = _tutorial()
+    cohorts = []
+    for i in range(args.depth):
+        loci = args.serve_loci if i % 4 != 3 \
+            else max(args.serve_loci // 2, 16)
+        df_s, df_g = tut.make_input_frames(
+            num_loci=loci,
+            cells_per_clone=args.serve_cells_per_clone,
+            seed=args.ab_seed + i)
+        cohorts.append(tut.simulate_pert_frames(
+            df_s, df_g, num_reads=args.ab_num_reads, lamb=0.75, a=10.0,
+            seed=args.ab_seed + 100 + i))
+    options = {
+        "max_iter": int(args.serve_max_iter),
+        "cn_prior_method": "g1_clones",
+        "mirror_rescue": False,
+    }
+    return cohorts, options
+
+
+def _serve_burst_arm(cohorts, options, workdir, args, max_batch, tag):
+    """One burst arm: every request submitted upfront, one worker
+    (slab width ``max_batch``) drains the whole burst.  End-to-end
+    latency per request = spool queue wait + service wall — the number
+    a caller experiences, and the one continuous batching moves."""
+    import json as _json
+
+    from scdna_replication_tools_tpu.serve import (
+        ServeWorker,
+        SpoolQueue,
+    )
+
+    queue = SpoolQueue(pathlib.Path(workdir) / f"spool_{tag}")
+    for df_s, df_g in cohorts:
+        queue.submit_frames(df_s, df_g, options=options)
+    worker = ServeWorker(queue, max_requests=len(cohorts),
+                         exit_when_idle=True, max_batch=max_batch)
+    t0 = time.perf_counter()
+    stats = worker.run()
+    total = time.perf_counter() - t0
+    ok = [o for o in stats["outcomes"] if o["status"] == "ok"]
+    if len(ok) != len(cohorts):
+        raise RuntimeError(f"{tag} burst arm: {len(cohorts) - len(ok)} "
+                           f"of {len(cohorts)} requests did not land "
+                           f"ok: {stats['by_status']}")
+    # queue wait per request from the worker log's request_start
+    # events (the spool-crossing span surfaced there)
+    waits = {}
+    with open(stats["worker_log"]) as fh:
+        for line in fh:
+            try:
+                ev = _json.loads(line)
+            except ValueError:
+                continue
+            if ev.get("event") == "request_start":
+                waits[ev.get("request_id")] = float(
+                    ev.get("queue_wait_seconds") or 0.0)
+    latencies = [waits.get(o["request_id"], 0.0) + o["wall_seconds"]
+                 for o in ok]
+    p50 = _percentile(latencies, 50)
+    p99 = _percentile(latencies, 99)
+    last = ok[-1]
+    return {
+        "arm": tag,
+        "max_batch": max_batch,
+        "requests": len(ok),
+        "total_wall_seconds": round(total, 2),
+        "requests_per_second": round(len(ok) / max(total, 1e-9), 4),
+        "latency_p50_seconds": round(p50, 2),
+        "latency_p99_seconds": round(p99, 2),
+        "p99_over_p50": round(p99 / max(p50, 1e-9), 2),
+        "latencies_seconds": [round(v, 2) for v in latencies],
+        "retired_early": sum(1 for o in ok if o.get("retired_early")),
+        "last_request_compile_cache": last["compile_cache"],
+        "last_request_log": last["run_log"],
+        "worker_log": stats["worker_log"],
+    }
+
+
+def run_serve_burst(args):
+    """``--serve-ab --depth N``: the continuous-batching A/B — the
+    same N-request burst (mixed buckets) through a strictly serial
+    worker vs a slab-batched one (``--serve-max-batch`` K).  Both arms
+    run warm (a two-bucket warmup pays every compile first), so the
+    delta is scheduling, not compilation."""
+    import tempfile
+
+    if args.platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    depth = int(args.depth)
+    if depth < 2:
+        raise SystemExit("bench: --depth wants at least 2 requests")
+    k = max(int(args.serve_max_batch), 2)
+    cohorts, options = _serve_burst_workload(args)
+    workdir = pathlib.Path(tempfile.mkdtemp(prefix="pert_serve_burst_"))
+
+    # warmup: BOTH program ledgers, both bucket rungs, through
+    # throwaway workers — the measured arms then ride the process's
+    # warm AOT cache and the delta is pure scheduling.  The serial
+    # warmup (one request per rung, max_batch=1) pays the solo chunk
+    # programs; the batched warmup (max_batch=k over a full-width
+    # same-rung pack of the BASE rung plus a pair per rung)
+    # rendezvous-packs so the slab rung-ladder programs (W=2 and the
+    # wider rungs the burst will hit) compile here, not inside the
+    # measured batched arm
+    warm_solo = [cohorts[0], cohorts[3]] if depth >= 4 \
+        else [cohorts[0]]
+    _serve_burst_arm(warm_solo, options, workdir, args, 1, "warmup")
+    base_idx = [i for i in range(depth) if i % 4 != 3]
+    half_idx = [i for i in range(depth) if i % 4 == 3]
+    warm_slab = [cohorts[base_idx[i % len(base_idx)]]
+                 for i in range(k)]
+    if half_idx:
+        warm_slab += [cohorts[half_idx[0]], cohorts[half_idx[-1]]]
+    _serve_burst_arm(warm_slab, options, workdir, args, k,
+                     "warmup_slab")
+
+    serial = _serve_burst_arm(cohorts, options, workdir, args, 1,
+                              "serial")
+    batched = _serve_burst_arm(cohorts, options, workdir, args, k,
+                               "batched")
+
+    last_cache = batched["last_request_compile_cache"] or {}
+    assert (last_cache.get("cache_misses") or 0) == 0, (
+        "batched arm's last request paid compile misses — the slab "
+        f"does not share the resident programs: {last_cache}")
+    assert batched["retired_early"] > 0, (
+        "batched burst saw no early retirement — blocks are gang-"
+        "scheduled, not continuously batched")
+
+    result = {
+        "metric": "pert_serve_batch_ab",
+        "workload": {
+            "depth": depth,
+            "max_batch": k,
+            "cells_per_clone": args.serve_cells_per_clone,
+            "num_loci": args.serve_loci,
+            "max_iter": options["max_iter"],
+            "num_reads": args.ab_num_reads,
+            "simulation_seed": args.ab_seed,
+            "mixed_buckets": True,
+        },
+        "platform": jax.devices()[0].platform,
+        "serial": serial,
+        "batched": batched,
+        "delta": {
+            "throughput_ratio": round(
+                batched["requests_per_second"]
+                / max(serial["requests_per_second"], 1e-9), 2),
+            "p99_speedup": round(
+                serial["latency_p99_seconds"]
+                / max(batched["latency_p99_seconds"], 1e-9), 2),
+            "p99_over_p50_serial": serial["p99_over_p50"],
+            "p99_over_p50_batched": batched["p99_over_p50"],
+        },
+        "note": "same burst in both arms, both warm (warmup pays the "
+                "compiles).  Serial drains the spool one request at a "
+                "time: a burst's tail request waits for every "
+                "predecessor, so p99 >> p50.  Batched runs up to K "
+                "same-rung requests as concurrent slab blocks of one "
+                "compiled program set — queue wait collapses and p99 "
+                "approaches p50.  Latency = spool queue wait + "
+                "service wall.  The batched arm's last request's "
+                "zero-miss compile ledger is asserted (one program "
+                "set serves the whole slab).  Read throughput_ratio "
+                "against the host: requests/s rises with K only where "
+                "the slab vectorizes into IDLE lanes (a TPU's "
+                "batch-indifferent MXU, or spare cores/SIMD width); "
+                "on this artifact's saturated single-core CPU the "
+                "waterfall's fit_attributed shows the packed program "
+                "costing ~1.2x a solo lane, so serial is already "
+                "throughput-optimal and the batching wins recorded "
+                "here are the latency SHAPE (p99_over_p50), early "
+                "retirement, and the shared program ledger.",
+    }
+    print(json.dumps(result))
+    if args.ab_out:
+        pathlib.Path(args.ab_out).parent.mkdir(parents=True,
+                                               exist_ok=True)
+        with open(args.ab_out, "w") as fh:
+            json.dump(result, fh, indent=1)
+            fh.write("\n")
+    if args.serve_write_fleet_baseline:
+        from pert_fleet import run_record, write_baseline
+
+        record = run_record(batched["last_request_log"])
+        write_baseline(record, args.serve_write_fleet_baseline)
+        print(f"bench: serve fleet baseline written to "
+              f"{args.serve_write_fleet_baseline} (batched arm)",
+              file=sys.stderr)
+    return result
+
+
 def run_serve_ab(args):
     """Serving A/B (ROADMAP item 2 exit evidence): N queued requests
     through one warm worker vs N cold CLI runs — same cohorts, same
@@ -1198,7 +1423,10 @@ def main():
         return
 
     if args.serve_ab:
-        run_serve_ab(args)
+        if args.depth:
+            run_serve_burst(args)
+        else:
+            run_serve_ab(args)
         return
 
     if args.enum_ab:

@@ -125,6 +125,7 @@ class scRT:
                  watchdog_compile_seconds=None,
                  watchdog_chunk_seconds=None, elastic_mesh=True,
                  pad_cells_to=None, pad_loci_to=None, request_id=None,
+                 slab_width=None,
                  trace_spans=False, trace_parent=None,
                  enum_impl='auto', fused_adam='auto',
                  optimizer_state_dtype='float32', cn_hmm_self_prob=None,
@@ -169,7 +170,7 @@ class scRT:
             watchdog_chunk_seconds=watchdog_chunk_seconds,
             elastic_mesh=elastic_mesh,
             pad_cells_to=pad_cells_to, pad_loci_to=pad_loci_to,
-            request_id=request_id,
+            request_id=request_id, slab_width=slab_width,
             trace_spans=trace_spans, trace_parent=trace_parent,
             enum_impl=enum_impl, fused_adam=fused_adam,
             optimizer_state_dtype=optimizer_state_dtype,
@@ -294,6 +295,10 @@ class scRT:
             # per-request identity for the fleet index (`--request`);
             # folded into run_start by the pending-context path
             run_log.add_context(request_id=str(self.config.request_id))
+        if self.config.slab_width:
+            # batched-serving provenance: this run executed as one
+            # block of a width-K slab (worker --max-batch)
+            run_log.add_context(slab_width=int(self.config.slab_width))
         self.run_log_path = run_log.path
         with run_log.session(config=self.config, timer=timer):
             with timer.phase("clone_prep"):

@@ -34,6 +34,7 @@ NON_HASH_FIELDS = (
     "request_id",           # per-request identity (serve fleet index)
     "trace_spans",          # tracing on/off is pure observability
     "trace_parent",         # per-request trace handoff
+    "slab_width",           # serving-slab placement, not workload
 )
 
 
@@ -160,6 +161,15 @@ class PertConfig:
     # trace_spans is off.  Excluded from the config hash like
     # request_id — it is pure per-request identity.
     trace_parent: Optional[str] = None
+    # continuous-batching placement: the serving slab width (worker
+    # --max-batch) this run executed as a block of; None = standalone.
+    # Stamped into the run log's context so batched-run provenance is
+    # queryable, and EXCLUDED from the config hash like request_id —
+    # the same workload batched or serial must hash equal (that
+    # equality is what lets the serial/batched A/B arms share one
+    # compiled program set).  No behavioural effect: the per-block
+    # shapes come from the bucket padding, not from the slab width.
+    slab_width: Optional[int] = None
     # write checkpoints at step boundaries (step1/step2/step3) to this dir.
     checkpoint_dir: Optional[str] = None
     # --- durable runs (see OBSERVABILITY.md "Durable runs & resume") ---

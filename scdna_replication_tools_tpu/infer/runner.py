@@ -231,6 +231,10 @@ class PertInference:
             # Directly-driven runners only — the api facade stamps the
             # log it owns itself, before its session opens
             self.run_log.add_context(request_id=str(config.request_id))
+        if config.slab_width and run_log is None:
+            # batched-serving provenance (worker --max-batch): this
+            # run was one block of a width-K slab
+            self.run_log.add_context(slab_width=int(config.slab_width))
         # persistent XLA compilation cache (no-op when already configured
         # or disabled): repeated runs skip the per-step-program compiles
         self.compile_cache_dir = profiling.enable_persistent_compile_cache(

@@ -24,6 +24,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
+import threading
 import time
 from typing import Callable, Optional
 
@@ -278,6 +279,200 @@ def _run_fit_chunk(loss_fn: Callable, params0: dict, opt_state0, losses0,
                      fused_adam=fused_adam, moment_dtype=moment_dtype)
 
 
+# ---------------------------------------------------------------------------
+# Slab twin of the chunk program: continuous batching for serving
+# ---------------------------------------------------------------------------
+#
+# ``_run_fit_chunk_slab`` maps the chunk program over a leading BLOCK
+# axis: W same-shaped requests (the serving bucket ladder guarantees
+# equal shapes within a rung) advance one chunk in ONE dispatch, each
+# block carrying its own params/opt-state/loss-buffer and its own
+# dynamic ``i0``/``stop``/``min_iter``/``rel_tol``/``lr`` scalars.  The
+# per-chunk controller verdicts come back PER BLOCK (converged/is_nan
+# vectors), which is what lets the serving slab retire a converged
+# request at a chunk boundary and refill its block with a fresh one —
+# the way vectorized-MCMC ensembles retire converged chains
+# (arXiv:2503.17405) without stalling the rest.
+#
+# Retirement/vacancy convention: a block whose ``stop`` equals its
+# ``i0`` has an immediately-false loop condition — its carry passes
+# through UNTOUCHED (vmap-of-while_loop masks the lane), so parked
+# blocks cost only the masked lane's share of each fused step.
+#
+# ``fused_adam='pallas'`` is not supported under the slab (the Pallas
+# kernel's batching rule is unvalidated here); 'off' and 'xla' are.
+SLAB_STATIC_ARGNAMES = CHUNK_STATIC_ARGNAMES
+SLAB_DONATE_ARGNAMES = CHUNK_DONATE_ARGNAMES
+
+
+@functools.partial(jax.jit, static_argnames=SLAB_STATIC_ARGNAMES,
+                   donate_argnames=SLAB_DONATE_ARGNAMES)
+def _run_fit_chunk_slab(loss_fn: Callable, params0: dict, opt_state0,
+                        losses0, diag0, i0, stop, min_iter, rel_tol, lr,
+                        loss_args: tuple,
+                        conv_window: int, b1: float, b2: float,
+                        diag_every: int,
+                        fused_adam: str = "off",
+                        moment_dtype: str = "float32"):
+    if fused_adam.startswith("pallas"):
+        raise ValueError(
+            "fused_adam='pallas*' is not supported in the slab program; "
+            "use 'off' or 'xla'")
+
+    def _block(params0_b, opt_state0_b, losses0_b, diag0_b, i0_b,
+               stop_b, min_iter_b, rel_tol_b, lr_b, loss_args_b):
+        init = (i0_b, params0_b, opt_state0_b, losses0_b, diag0_b,
+                jnp.asarray(False), jnp.asarray(False),
+                jnp.asarray(False))
+        return _fit_loop(loss_fn, lr_b, b1, b2, loss_args_b, diag_every,
+                         conv_window, stop_b, min_iter_b, rel_tol_b,
+                         init, fused_adam=fused_adam,
+                         moment_dtype=moment_dtype)
+
+    return jax.vmap(_block)(params0, opt_state0, losses0, diag0,
+                            jnp.asarray(i0), jnp.asarray(stop),
+                            jnp.asarray(min_iter), jnp.asarray(rel_tol),
+                            jnp.asarray(lr), loss_args)
+
+
+def slab_pack(blocks):
+    """Stack per-block pytrees (equal treedefs/shapes) along a new
+    leading block axis — the host-side packer for the slab program."""
+    return jax.tree_util.tree_map(lambda *leaves: jnp.stack(leaves),
+                                  *blocks)
+
+
+def slab_block(slab, index: int):
+    """Extract block ``index`` from a slab pytree (drops the block
+    axis) — the retirement path hands this back to the per-request
+    decode."""
+    return jax.tree_util.tree_map(lambda leaf: leaf[index], slab)
+
+
+def slab_fill(slab, index: int, block):
+    """Functionally replace block ``index`` of ``slab`` — the refill
+    path, when a freshly admitted request takes over a vacated block.
+
+    Returns the new slab; the input slab's buffers are NOT donated here
+    (refill happens on the host between chunk dispatches, where the old
+    slab may still back a retiring block's decode)."""
+    return jax.tree_util.tree_map(
+        lambda leaf, b: leaf.at[index].set(b), slab, block)
+
+
+# ---------------------------------------------------------------------------
+# Pluggable chunk dispatcher: the seam continuous batching hooks
+# ---------------------------------------------------------------------------
+#
+# The chunked fit driver (``_fit_map_controlled``) dispatches every chunk
+# through ONE seam: when a per-thread dispatcher is installed
+# (``set_chunk_dispatcher``), each chunk is handed over as a
+# :class:`ChunkCall` instead of being dispatched solo.  The batched
+# serving worker (serve/slab.SlabFitCoordinator) uses this to rendezvous
+# concurrent same-signature chunks from K request threads and advance
+# them in one ``_run_fit_chunk_slab`` dispatch — the continuous-batching
+# slab.  The seam is thread-local on purpose: the worker's block threads
+# opt in individually, and everything else (serial mode, notebooks,
+# tests) never sees a dispatcher.
+#
+# Numerics contract (pinned by tests/test_slab.py): a PACKED lane runs
+# the vectorized slab program, whose fused update chain may differ from
+# the solo program by ~1 ulp per step on some backends (value-dependent
+# instruction selection — e.g. XLA:CPU picks different vector widths for
+# (W, N) and (N,) layouts).  Lanes never exchange values — per-lane
+# results are independent of WHO shares the slab — but bit-identity with
+# the solo program holds only for dispatch groups of one, which the
+# coordinator routes through ``ChunkCall.solo``.
+
+_CHUNK_DISPATCHER_TLS = threading.local()
+
+
+def set_chunk_dispatcher(dispatcher) -> None:
+    """Install (``None`` clears) this thread's chunk dispatcher.
+
+    The dispatcher must provide ``dispatch(call: ChunkCall)`` returning a
+    ``_run_fit_chunk``-shaped output tuple, plus ``fit_begin()`` /
+    ``fit_end()`` bracketing calls the chunked driver emits around each
+    fit so the dispatcher knows how many threads are actively fitting."""
+    _CHUNK_DISPATCHER_TLS.dispatcher = dispatcher
+
+
+def get_chunk_dispatcher():
+    """This thread's chunk dispatcher, or None (the default)."""
+    return getattr(_CHUNK_DISPATCHER_TLS, "dispatcher", None)
+
+
+@dataclasses.dataclass
+class ChunkCall:
+    """One chunk dispatch, reified for a dispatcher.
+
+    ``args`` is the full ``_run_fit_chunk`` dynamic-argument tuple
+    ``(params, opt_state, losses, diag, i0, stop, min_iter, rel_tol, lr,
+    loss_args)``; ``solo`` dispatches it through the caller's (possibly
+    AOT-compiled) solo program.  ``signature()`` is the pack-compatibility
+    key: calls pack into one slab only when loss_fn, statics and every
+    abstract leaf signature agree."""
+
+    loss_fn: Callable
+    args: tuple
+    static_kwargs: dict
+    solo: Callable
+
+    def signature(self):
+        try:
+            lf = hash(self.loss_fn)
+        except TypeError:
+            lf = id(self.loss_fn)
+        return (lf, tuple(sorted(self.static_kwargs.items())),
+                _abstract_sig(self.args))
+
+
+def dispatch_chunk_slab(calls, width: int, timings: Optional[dict] = None):
+    """Advance every call's block in ONE ``_run_fit_chunk_slab``
+    dispatch; returns one ``_run_fit_chunk``-shaped output tuple per
+    call, in order.
+
+    The slab is dispatched at the nearest POWER-OF-TWO width rung at or
+    above the live lane count (2, 4, 8, ...; ``width`` is only a floor
+    for the rung ladder's cap semantics at the caller): vacancies
+    within a rung are padded with parked copies of the lead lane
+    (``stop == i0`` — frozen passthrough, results discarded).  The
+    rung ladder keeps the compile ledger bounded — at most log2(K)
+    programs per signature, each warm after its first use across
+    retire/refill churn — while a pair of live lanes costs a 2-wide
+    program, not a K-wide one (on a SIMD-saturated host, padded lanes
+    are not free).  Callers must pre-group by ``ChunkCall.signature()``;
+    mixed-signature packs are a usage error (jnp.stack would throw on
+    shape mismatch)."""
+    W = 2
+    while W < len(calls):
+        W *= 2
+    cols = list(zip(*[c.args for c in calls]))
+    pad = W - len(calls)
+    if pad:
+        lead = calls[0].args
+        for _ in range(pad):
+            for j in range(len(cols)):
+                # parked lane: lead's buffers with stop pinned to i0
+                cols[j] = cols[j] + (lead[4] if j == 5 else lead[j],)
+    packed = [slab_pack(list(col)) for col in cols]
+    lead_call = calls[0]
+    static_kwargs = dict(lead_call.static_kwargs)
+    _timings: dict = timings if timings is not None else {}
+    compiled = _resolve_program(_run_fit_chunk_slab, f"slab{W}",
+                                lead_call.loss_fn, tuple(packed), {},
+                                static_kwargs, _timings)
+    if compiled is not None:
+        out = compiled(*packed)
+    else:
+        out = _run_fit_chunk_slab(lead_call.loss_fn, *packed,
+                                  **static_kwargs)
+    i_o, params_o, opt_o, losses_o, diag_o, conv_o, nan_o = out
+    return [(i_o[b], slab_block(params_o, b), slab_block(opt_o, b),
+             losses_o[b], diag_o[b], conv_o[b], nan_o[b])
+            for b in range(len(calls))]
+
+
 def make_opt_state(params: dict, learning_rate: float = 0.05,
                    b1: float = 0.8, b2: float = 0.99,
                    moment_dtype: str = "float32"):
@@ -323,6 +518,11 @@ def make_opt_state(params: dict, learning_rate: float = 0.05,
 
 _PROGRAM_CACHE: "collections.OrderedDict" = collections.OrderedDict()
 _PROGRAM_CACHE_MAX = 32
+# dict ops only (get/move_to_end/insert/evict) — compilation itself runs
+# unlocked, so two threads cold-missing the same key may both compile
+# (last insert wins; same cost as two serial cold runs).  The batched
+# serving worker dispatches fits from concurrent block threads.
+_PROGRAM_CACHE_LOCK = threading.Lock()
 
 
 def _leaf_sig(leaf):
@@ -374,16 +574,19 @@ def _resolve_program(target, tag: str, loss_fn, dynamic_args,
         hash(key)
     except TypeError:
         _runlog.current().emit("compile", key_hash="unhashable",
-                               label=type(loss_fn).__name__,
+                               label=type(loss_fn).__name__, tag=tag,
                                cache="uncacheable")
         return None  # unhashable loss callable/sharding: fall back
-    cached = _PROGRAM_CACHE.get(key)
+    with _PROGRAM_CACHE_LOCK:
+        cached = _PROGRAM_CACHE.get(key)
+        if cached is not None:
+            _PROGRAM_CACHE.move_to_end(key)
     if cached is not None:
-        _PROGRAM_CACHE.move_to_end(key)
         timings["program_cache"] = "hit"
         compiled, stats = cached
         _runlog.current().emit("compile", key_hash=_key_hash(key),
-                               label=type(loss_fn).__name__, cache="hit",
+                               label=type(loss_fn).__name__, tag=tag,
+                               cache="hit",
                                trace_seconds=0.0, compile_seconds=0.0,
                                **stats)
         return compiled
@@ -409,12 +612,14 @@ def _resolve_program(target, tag: str, loss_fn, dynamic_args,
     timings["program_cache"] = "miss"
     stats = _runlog.compiled_program_stats(compiled)
     _runlog.current().emit("compile", key_hash=_key_hash(key),
-                           label=type(loss_fn).__name__, cache="miss",
+                           label=type(loss_fn).__name__, tag=tag,
+                           cache="miss",
                            trace_seconds=round(t1 - t0, 4),
                            compile_seconds=round(t2 - t1, 4), **stats)
-    _PROGRAM_CACHE[key] = (compiled, stats)
-    while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_MAX:
-        _PROGRAM_CACHE.popitem(last=False)
+    with _PROGRAM_CACHE_LOCK:
+        _PROGRAM_CACHE[key] = (compiled, stats)
+        while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_MAX:
+            _PROGRAM_CACHE.popitem(last=False)
     return compiled
 
 
@@ -698,14 +903,25 @@ def _fit_map_controlled(loss_fn: Callable, params0: dict, loss_args: tuple,
                                 probe_args, {}, static_kwargs, timings,
                                 compile_deadline=compile_deadline)
 
+    def run_solo(args):
+        if compiled is not None:
+            return compiled(*args)
+        return _run_fit_chunk(loss_fn, *args, **static_kwargs)
+
+    # captured ONCE per fit: the dispatcher seam is thread-local and the
+    # chunk loop must not change engines mid-fit
+    dispatcher = get_chunk_dispatcher()
+
     def run_chunk(params, opt_state, losses, diag, i_host, stop_host,
                   lr_val):
         args = (params, opt_state, losses, diag, as_i32(i_host),
                 as_i32(stop_host), min_iter_arr, rel_tol_arr,
                 as_f32(lr_val), loss_args)
-        if compiled is not None:
-            return compiled(*args)
-        return _run_fit_chunk(loss_fn, *args, **static_kwargs)
+        if dispatcher is not None:
+            return dispatcher.dispatch(ChunkCall(
+                loss_fn=loss_fn, args=args, static_kwargs=static_kwargs,
+                solo=run_solo))
+        return run_solo(args)
 
     params, opt_state = params0, opt_state0
     i_host = i0_host
@@ -746,6 +962,10 @@ def _fit_map_controlled(loss_fn: Callable, params0: dict, loss_args: tuple,
         stagnation_anchor=stagnation_anchor, prev_verdict=prev_verdict)
 
     t0 = time.perf_counter()
+    # bracket the whole chunk loop so the dispatcher's barrier knows how
+    # many threads are actively fitting (vs in host-side pipeline work)
+    if dispatcher is not None:
+        dispatcher.fit_begin()
     try:
         (i_host, params, opt_state, losses, diag, losses_np,
          converged_flag, nan_flag, budget, decisions, best_loss,
@@ -767,6 +987,9 @@ def _fit_map_controlled(loss_fn: Callable, params0: dict, loss_args: tuple,
     except BaseException:
         _emergency_save(checkpoint_cb, snap)
         raise
+    finally:
+        if dispatcher is not None:
+            dispatcher.fit_end()
 
     n = i_host
     losses_host = losses_np[:n] if losses_np is not None \

@@ -43,6 +43,7 @@ import json
 import math
 import os
 import pathlib
+import threading
 from typing import Dict, List, Optional, Tuple
 
 from scdna_replication_tools_tpu.utils.fileio import atomic_write_bytes
@@ -165,6 +166,13 @@ def _round6(value: float):
     return int(v) if v == int(v) and abs(v) < 1e15 else v
 
 
+# one lock for every series mutation in the process: a serving worker's
+# registry takes increments from concurrent request block threads, and
+# read-modify-write on a counter must not lose updates.  Contention is
+# negligible — metrics ops are rare and tiny.
+_MUTATE_LOCK = threading.Lock()
+
+
 class _Series:
     """One (name, labels) series: the handle ``counter()``/``gauge()``/
     ``histogram()`` return."""
@@ -182,26 +190,29 @@ class _Series:
             self.count = 0
 
     def inc(self, amount=1) -> None:
-        self.value = (self.value or 0) + amount
+        with _MUTATE_LOCK:
+            self.value = (self.value or 0) + amount
 
     def set(self, value) -> None:
         self.value = value
 
     def set_max(self, value) -> None:
-        if self.value is None or value > self.value:
-            self.value = value
+        with _MUTATE_LOCK:
+            if self.value is None or value > self.value:
+                self.value = value
 
     def observe(self, value) -> None:
         value = float(value)
         if math.isnan(value):
             return
-        self.sum += value
-        self.count += 1
-        for i, edge in enumerate(self.buckets):
-            if value <= edge:
-                self.counts[i] += 1
-                return
-        self.counts[-1] += 1
+        with _MUTATE_LOCK:
+            self.sum += value
+            self.count += 1
+            for i, edge in enumerate(self.buckets):
+                if value <= edge:
+                    self.counts[i] += 1
+                    return
+            self.counts[-1] += 1
 
 
 class _NullSeries:
@@ -267,9 +278,12 @@ class MetricsRegistry:
         key = (name, _labels_key(labels))
         series = self._series.get(key)
         if series is None:
-            buckets = (spec or {}).get("buckets")
-            series = _Series(kind, buckets=buckets)
-            self._series[key] = series
+            with _MUTATE_LOCK:
+                series = self._series.get(key)
+                if series is None:
+                    buckets = (spec or {}).get("buckets")
+                    series = _Series(kind, buckets=buckets)
+                    self._series[key] = series
         return series
 
     def counter(self, name: str, labels: Optional[dict] = None) -> _Series:
@@ -599,33 +613,38 @@ class _NullRegistry:
 
 
 _NULL = _NullRegistry()
-_ACTIVE: Optional[MetricsRegistry] = None
+
+# the active-registry seam is THREAD-LOCAL, like the RunLog stack and
+# the fault plan: a batched serving worker runs one request pipeline
+# per block thread, and each request's install must scope that thread
+# only — single-thread behaviour is unchanged (install and read happen
+# on the same thread).
+_TLS = threading.local()
 
 
 def install(registry: Optional[MetricsRegistry]) -> None:
-    """Install (or clear, with None) the process-wide active registry.
+    """Install (or clear, with None) this THREAD's active registry.
 
-    Process-global on purpose, like :func:`obs.runlog.current` and the
-    fault plan: the instrumented layers (the RunLog emit hook, the
-    PhaseTimer sink, trace_summary) have no config plumbing.  The
-    newest runner's registry wins; tests install and clear per case.
+    A seam on purpose, like :func:`obs.runlog.current` and the fault
+    plan: the instrumented layers (the RunLog emit hook, the PhaseTimer
+    sink, trace_summary) have no config plumbing.  The newest runner's
+    registry wins; tests install and clear per case.
     """
-    global _ACTIVE
-    _ACTIVE = registry
+    _TLS.active = registry
 
 
 def uninstall(registry) -> None:
     """Clear the active registry — but only if it is still ``registry``
     (a newer run's install must not be clobbered by an older run's
     cleanup)."""
-    global _ACTIVE
-    if _ACTIVE is registry:
-        _ACTIVE = None
+    if getattr(_TLS, "active", None) is registry:
+        _TLS.active = None
 
 
 def current():
-    """The active registry, or the null no-op instance."""
-    return _ACTIVE if _ACTIVE is not None else _NULL
+    """This thread's active registry, or the null no-op instance."""
+    active = getattr(_TLS, "active", None)
+    return active if active is not None else _NULL
 
 
 def attach_phase_sink(timer, registry: Optional[MetricsRegistry] = None

@@ -31,6 +31,7 @@ import itertools
 import json
 import os
 import pathlib
+import threading
 import time
 from typing import Optional
 
@@ -276,6 +277,10 @@ class RunLog:
         # attached (closed just before run_end so its span_end rides
         # inside the stream)
         self._root_span = None
+        # serialises the seq counter and the file write: a serving
+        # worker's log receives emits from every concurrent request
+        # block thread (request lifecycle events, span_end sinks)
+        self._emit_lock = threading.Lock()
 
     @classmethod
     def create(cls, telemetry_path, run_name: str = "pert") -> "RunLog":
@@ -423,7 +428,7 @@ class RunLog:
             return
         t0 = time.perf_counter()
         self.open_run(config=config, run_name=run_name)
-        _STACK.append(self)
+        _stack().append(self)
         prev_sink = None
         if timer is not None:
             prev_sink = getattr(timer, "on_add", None)
@@ -456,8 +461,9 @@ class RunLog:
         finally:
             if timer is not None:
                 timer.on_add = prev_sink
-            if _STACK and _STACK[-1] is self:
-                _STACK.pop()
+            stack = _stack()
+            if stack and stack[-1] is self:
+                stack.pop()
 
     # -- emission ---------------------------------------------------------
 
@@ -489,55 +495,86 @@ class RunLog:
         registry = self.metrics_registry if self.metrics_registry \
             is not None else _metrics.current()
         registry.record_event(event, payload)
-        if not self.enabled or not self._open:
-            return
-        record = {"event": event, "seq": self._seq,
-                  "t": round(self._elapsed(), 4), **payload}
-        # the span envelope (schema v8): every event emitted while a
-        # span is open carries the causal context it happened under —
-        # ONLY when a tracer is attached (tracing-off streams carry no
-        # span bytes), and not on span_end itself (it carries its own
-        # ids at the top level)
-        if self.tracer is not None and event != "span_end" \
-                and "span" not in record:
-            cur = self.tracer.current()
-            if cur is not None:
-                record["span"] = {"trace_id": cur.trace_id,
-                                  "span_id": cur.span_id,
-                                  "parent_id": cur.parent_id}
-        self._seq += 1
-        try:
-            if self._fh is None:
-                os.makedirs(os.path.dirname(os.path.abspath(self.path)),
-                            exist_ok=True)
-                # "w", not "a": one run = one file (the schema contract
-                # validate_run pins — seq is the line index); re-running
-                # against an explicit path replaces the previous run
-                # instead of silently stacking two streams in one file
-                self._fh = open(self.path, "w")
-            self._fh.write(json.dumps(record, default=_json_safe) + "\n")
-            self._fh.flush()
-        except (OSError, TypeError, ValueError) as exc:
-            self.enabled = False
-            logger.warning("run log disabled: cannot write %s (%s)",
-                           self.path, exc)
-            if self._fh is not None:
-                try:
-                    self._fh.close()
-                except OSError:
-                    pass
-                self._fh = None
+        with self._emit_lock:
+            if not self.enabled or not self._open:
+                return
+            record = {"event": event, "seq": self._seq,
+                      "t": round(self._elapsed(), 4), **payload}
+            # the span envelope (schema v8): every event emitted while a
+            # span is open carries the causal context it happened under —
+            # ONLY when a tracer is attached (tracing-off streams carry no
+            # span bytes), and not on span_end itself (it carries its own
+            # ids at the top level)
+            if self.tracer is not None and event != "span_end" \
+                    and "span" not in record:
+                cur = self.tracer.current()
+                if cur is not None:
+                    record["span"] = {"trace_id": cur.trace_id,
+                                      "span_id": cur.span_id,
+                                      "parent_id": cur.parent_id}
+            self._seq += 1
+            try:
+                if self._fh is None:
+                    os.makedirs(
+                        os.path.dirname(os.path.abspath(self.path)),
+                        exist_ok=True)
+                    # "w", not "a": one run = one file (the schema
+                    # contract validate_run pins — seq is the line
+                    # index); re-running against an explicit path
+                    # replaces the previous run instead of silently
+                    # stacking two streams in one file
+                    self._fh = open(self.path, "w")
+                self._fh.write(json.dumps(record, default=_json_safe)
+                               + "\n")
+                self._fh.flush()
+            except (OSError, TypeError, ValueError) as exc:
+                self.enabled = False
+                logger.warning("run log disabled: cannot write %s (%s)",
+                               self.path, exc)
+                if self._fh is not None:
+                    try:
+                        self._fh.close()
+                    except OSError:
+                        pass
+                    self._fh = None
 
 
 _NULL = RunLog(None)
-_STACK: list = []
+
+# the :func:`current` seam is THREAD-LOCAL: a batched serving worker
+# runs one request pipeline per block thread, each with its own RunLog
+# session — compile/fault events emitted through ``current()`` must
+# land on the emitting thread's log, never a slab neighbour's.  A fresh
+# thread starts with an empty stack; code that hands work to a helper
+# thread (utils.faults.run_with_deadline) propagates the caller's stack
+# explicitly via :func:`stack_snapshot` / :func:`install_stack`.
+_TLS = threading.local()
+
+
+def _stack() -> list:
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []
+    return stack
+
+
+def stack_snapshot() -> tuple:
+    """The calling thread's RunLog stack, for cross-thread handoff."""
+    return tuple(_stack())
+
+
+def install_stack(snapshot) -> None:
+    """Adopt another thread's stack (see :func:`stack_snapshot`)."""
+    _TLS.stack = list(snapshot)
 
 
 def current() -> RunLog:
-    """The innermost active RunLog, or a disabled no-op instance.
+    """The innermost RunLog active ON THIS THREAD, or a disabled no-op
+    instance.
 
     The seam for layers without an explicit handle: ``infer/svi.py``
-    emits ``compile`` events through this, so the AOT program cache
+    emits ``compile`` events through it, so the AOT program cache
     stays decoupled from the orchestration layer.
     """
-    return _STACK[-1] if _STACK else _NULL
+    stack = _stack()
+    return stack[-1] if stack else _NULL

@@ -346,6 +346,17 @@ def attach_tracer(run_log, tracer: Optional[SpanTracer]) -> None:
     run_log.tracer = tracer
 
 
+def attach_sink(run_log, tracer: SpanTracer) -> None:
+    """Wire ONLY the tracer's span_end sink onto a RunLog, without
+    occupying the log's single ``tracer`` slot: the batched serving
+    worker runs K concurrent request tracers against its one worker
+    log — their closed spans all land there as ``span_end`` events,
+    but no one tracer may own the log-level span envelope (so worker
+    events in batched mode carry no ``span`` envelope; documented in
+    OBSERVABILITY.md "Serving")."""
+    tracer.sink = functools.partial(_emit_span_end, run_log)
+
+
 def _emit_span_end(run_log, payload: dict) -> None:
     run_log.emit("span_end", **payload)
 

@@ -19,6 +19,11 @@ long-lived worker:
   directory (atomic ticket submission, rename-based claiming, a
   per-request results tree).  Simple, testable, CI-able; no network
   dependency — a network front-end can feed the same spool later;
+* :mod:`~scdna_replication_tools_tpu.serve.slab` — the continuous-
+  batching slab ledger: with ``max_batch`` K > 1 the worker runs up to
+  K same-bucket-rung requests as concurrent blocks sharing the one
+  resident program set; converged blocks retire at once (stream-back
+  overlaps the peers' fit) and vacated blocks refill from the spool;
 * :mod:`~scdna_replication_tools_tpu.serve.worker` — the worker
   daemon: admits requests, runs each as one :class:`api.scRT` pipeline
   with per-request RunLog + metrics registry + checkpoint dir (fault
@@ -40,8 +45,13 @@ from scdna_replication_tools_tpu.serve.buckets import (  # noqa: F401
     BucketSet,
 )
 from scdna_replication_tools_tpu.serve.queue import (  # noqa: F401
+    PRIORITY_CLASSES,
     RequestTicket,
     SpoolQueue,
+)
+from scdna_replication_tools_tpu.serve.slab import (  # noqa: F401
+    SlabFitCoordinator,
+    SlabState,
 )
 from scdna_replication_tools_tpu.serve.worker import (  # noqa: F401
     ServeWorker,

@@ -135,5 +135,23 @@ class BucketSet:
                                 self.cells[-1], self.loci[-1])
         return Bucket(cells=cells, loci=loci)
 
+    def select_hint(self, shape) -> "Bucket | None":
+        """Bucket for a ticket's advisory ``shape`` hint
+        (``{"num_cells_s", "num_cells_g1", "num_loci"}``, written by
+        ``SpoolQueue.submit_frames``), or None when the hint is
+        absent/malformed/oversized — the batched worker's
+        same-rung claim predicate runs on this WITHOUT reading the
+        input TSVs, and a None simply defers the decision to real
+        admission."""
+        if not isinstance(shape, dict):
+            return None
+        try:
+            cells = max(int(shape["num_cells_s"]),
+                        int(shape["num_cells_g1"]))
+            loci = int(shape["num_loci"])
+            return self.select(cells, loci)
+        except (BucketRefusal, KeyError, ValueError, TypeError):
+            return None
+
     def describe(self) -> dict:
         return {"cells": list(self.cells), "loci": list(self.loci)}

@@ -3,14 +3,18 @@
 shim for checkouts driven without an install).
 
     # start a worker on a spool directory (holds the warm program
-    # cache; drains gracefully on SIGTERM/SIGINT)
-    pert-serve worker --spool /data/pert_spool \\
+    # cache; drains gracefully on SIGTERM/SIGINT).  --max-batch K > 1
+    # turns on continuous batching: up to K same-bucket requests run
+    # as concurrent blocks of one slab
+    pert-serve worker --spool /data/pert_spool --max-batch 4 \\
         --metrics-textfile /var/lib/node_exporter/pert_serve.prom
 
     # submit a request (returns the request id immediately; the fit
-    # runs asynchronously in the worker)
+    # runs asynchronously in the worker).  --priority high|normal|low
+    # and --deadline-seconds steer the claim order
     pert-serve submit --spool /data/pert_spool cn_s.tsv cn_g1.tsv \\
-        --option max_iter=800 --option clone_col=clone_id
+        --option max_iter=800 --option clone_col=clone_id \\
+        --priority high --deadline-seconds 600
 
     # poll / collect
     pert-serve status --spool /data/pert_spool <request_id>
@@ -102,6 +106,14 @@ def main(argv=None) -> int:
                           help="default scRT option applied to every "
                                "request (tickets override per "
                                "request); repeatable")
+    p_worker.add_argument("--max-batch", type=int, default=1,
+                          help="continuous-batching width K (default "
+                               "1 = strictly serial): run up to K "
+                               "same-bucket-rung requests as "
+                               "concurrent blocks of one slab sharing "
+                               "the resident compiled programs; "
+                               "converged blocks retire and refill "
+                               "from the spool at once")
     p_worker.add_argument("--trace-spans", default=True,
                           action=argparse.BooleanOptionalAction,
                           help="causal span tracing per request "
@@ -121,6 +133,17 @@ def main(argv=None) -> int:
     p_submit.add_argument("g1_phase_cells",
                           help="long-form tsv for G1-phase cells")
     p_submit.add_argument("--request-id", default=None)
+    p_submit.add_argument("--priority", default="normal",
+                          help="SLO priority class (high|normal|low, "
+                               "default normal): workers claim by "
+                               "class, then oldest deadline, then "
+                               "submission order")
+    p_submit.add_argument("--deadline-seconds", type=float,
+                          default=None,
+                          help="soft SLO deadline this many seconds "
+                               "from submission; within a priority "
+                               "class, oldest deadline is claimed "
+                               "first")
     p_submit.add_argument("--option", action="append", default=[],
                           metavar="KEY=VALUE",
                           help="per-request scRT option (whitelist: "
@@ -158,15 +181,23 @@ def main(argv=None) -> int:
             max_requests=args.max_requests,
             exit_when_idle=args.exit_when_idle,
             default_options=_parse_option(args.option),
-            trace_spans=args.trace_spans)
+            trace_spans=args.trace_spans,
+            max_batch=args.max_batch)
         stats = worker.run()
         _emit(json.dumps(stats, indent=1))
         return 0
 
     if args.cmd == "submit":
+        deadline = None
+        if args.deadline_seconds is not None:
+            import time as _time
+
+            deadline = _time.time() + float(args.deadline_seconds)
         rid = queue.submit(args.s_phase_cells, args.g1_phase_cells,
                            options=_parse_option(args.option),
-                           request_id=args.request_id)
+                           request_id=args.request_id,
+                           priority=args.priority,
+                           deadline_unix=deadline)
         _emit(rid)
         return 0
 

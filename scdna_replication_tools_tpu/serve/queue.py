@@ -29,12 +29,17 @@ Layout under the spool root::
                                 ticket, duplicated for collectors that
                                 only see the results tree)
 
-Tickets are ordered FIFO by submission time (ticket mtime, request id
-as the same-instant tiebreak — caller-supplied ids must not jump the
-queue).  ``options`` is the whitelisted subset of ``scRT``
-keyword arguments a request may override (budgets, prior method,
-faults for chaos suites, ...) — the worker merges them over its own
-defaults; see ``serve/worker.py::REQUEST_OPTION_KEYS``.
+Claim order is priority-class first (``high`` > ``normal`` > ``low``,
+ticket-borne, default ``normal``), oldest-deadline-first within a
+class (``deadline_unix``, optional), then FIFO by submission time
+(ticket mtime, request id as the same-instant tiebreak —
+caller-supplied ids must not jump the queue).  A ticket carrying an
+unknown priority class is parked as ``failed`` at claim time rather
+than wedging the queue — exactly like an unreadable ticket.
+``options`` is the whitelisted subset of ``scRT`` keyword arguments a
+request may override (budgets, prior method, faults for chaos suites,
+...) — the worker merges them over its own defaults; see
+``serve/worker.py::REQUEST_OPTION_KEYS``.
 """
 
 from __future__ import annotations
@@ -51,6 +56,11 @@ from scdna_replication_tools_tpu.utils.fileio import atomic_write_bytes
 
 _STATES = ("pending", "active", "done", "failed")
 _TICKET_COUNTER = itertools.count()
+
+# the SLO admission classes, best first.  Order within a class is
+# oldest-deadline-first, then submission FIFO — see SpoolQueue.pending.
+PRIORITY_CLASSES = ("high", "normal", "low")
+_PRIORITY_RANK = {name: i for i, name in enumerate(PRIORITY_CLASSES)}
 
 
 def _new_request_id() -> str:
@@ -75,6 +85,17 @@ class RequestTicket:
     # per-request run's whole span tree carry it, and pert_trace
     # stitches the worker + request logs into one timeline on it
     trace_id: Optional[str] = None
+    # SLO admission class (PRIORITY_CLASSES; absent in old tickets ->
+    # 'normal' via the from_json default) and the optional request
+    # deadline — the claim order is (class, oldest deadline, FIFO)
+    priority: str = "normal"
+    deadline_unix: Optional[float] = None
+    # shape hint ({"num_cells_s", "num_cells_g1", "num_loci"}), filled
+    # by submit_frames (it knows the frames): lets a batched worker
+    # claim same-bucket-rung neighbours for one slab WITHOUT reading
+    # the input TSVs.  Advisory only — admission re-probes the real
+    # frames; a hint-less ticket is still claimable
+    shape: Optional[dict] = None
     # terminal fields, filled by the worker's finish()
     status: Optional[str] = None          # ok / failed / refused
     error: Optional[str] = None
@@ -125,10 +146,18 @@ class SpoolQueue:
     # -- submission -------------------------------------------------------
 
     def submit(self, s_path, g1_path, options: Optional[dict] = None,
-               request_id: Optional[str] = None) -> str:
+               request_id: Optional[str] = None,
+               priority: str = "normal",
+               deadline_unix: Optional[float] = None,
+               shape: Optional[dict] = None) -> str:
         """Queue a request referencing existing input TSVs; returns the
         request id.  Submission is atomic: the worker either sees the
         whole ticket in ``pending/`` or nothing."""
+        if priority not in PRIORITY_CLASSES:
+            raise ValueError(
+                f"unknown priority {priority!r} — one of "
+                f"{PRIORITY_CLASSES} (a forged ticket with a bad class "
+                f"is parked at claim time; the API refuses upfront)")
         self.ensure_dirs()
         request_id = request_id or _new_request_id()
         if any(self._ticket_path(s, request_id).exists()
@@ -141,18 +170,25 @@ class SpoolQueue:
             request_id=request_id, s_path=str(s_path),
             g1_path=str(g1_path), options=dict(options or {}),
             submitted_unix=round(time.time(), 3),
-            trace_id=derive_trace_id(request_id))
+            trace_id=derive_trace_id(request_id),
+            priority=priority,
+            deadline_unix=(round(float(deadline_unix), 3)
+                           if deadline_unix is not None else None),
+            shape=dict(shape) if shape else None)
         atomic_write_bytes(self._ticket_path("pending", request_id),
                            ticket.to_json())
         return request_id
 
     def submit_frames(self, df_s, df_g1, options: Optional[dict] = None,
-                      request_id: Optional[str] = None) -> str:
+                      request_id: Optional[str] = None,
+                      priority: str = "normal",
+                      deadline_unix: Optional[float] = None) -> str:
         """Queue a request from in-memory long-form frames: the frames
         land as TSVs under ``data/<id>/`` BEFORE the ticket appears in
         ``pending/`` (the ticket's atomic rename is the commit point,
         so a worker can never claim a request whose data is still
-        being written)."""
+        being written).  Knowing the frames, it also stamps the
+        ticket's bucket-rung ``shape`` hint."""
         request_id = request_id or _new_request_id()
         data_dir = self.root / "data" / request_id
         data_dir.mkdir(parents=True, exist_ok=True)
@@ -160,39 +196,90 @@ class SpoolQueue:
         g1_path = data_dir / "cn_g1.tsv"
         df_s.to_csv(s_path, sep="\t", index=False)
         df_g1.to_csv(g1_path, sep="\t", index=False)
+        opts = options or {}
+        try:
+            cell_col = opts.get("cell_col", "cell_id")
+            chr_col = opts.get("chr_col", "chr")
+            start_col = opts.get("start_col", "start")
+            shape = {
+                "num_cells_s": int(df_s[cell_col].nunique()),
+                "num_cells_g1": int(df_g1[cell_col].nunique()),
+                "num_loci": int(df_s[[chr_col, start_col]]
+                                .drop_duplicates().shape[0]),
+            }
+        except (KeyError, TypeError):
+            shape = None  # unprobeable frames: admission decides
         return self.submit(s_path, g1_path, options=options,
-                           request_id=request_id)
+                           request_id=request_id, priority=priority,
+                           deadline_unix=deadline_unix, shape=shape)
 
     # -- worker side ------------------------------------------------------
 
     def pending(self) -> List[pathlib.Path]:
-        """Pending ticket paths in FIFO order: submission time (the
-        ticket file's mtime — set by the atomic commit), id as the
-        same-instant tiebreak.  Not lexical id alone: callers may
-        supply their own ``--request-id``, and a late 'a_urgent' must
-        not jump ahead of earlier generated ``req_...`` tickets."""
+        """Pending ticket paths in claim order: priority class first
+        (high > normal > low), oldest ``deadline_unix`` next within a
+        class, then submission time (the ticket file's mtime — set by
+        the atomic commit), id as the same-instant tiebreak.  Not
+        lexical id alone: callers may supply their own
+        ``--request-id``, and a late 'a_urgent' must not jump ahead of
+        earlier generated ``req_...`` tickets.
+
+        A ticket with an unknown/malformed priority sorts FIRST so the
+        next claim() immediately parks it as failed — a poisoned
+        ticket must not linger mid-queue, invisible, while traffic
+        flows around it."""
         root = self.root / "pending"
         if not root.is_dir():
             return []
 
         def _key(path: pathlib.Path):
             try:
-                return (path.stat().st_mtime, path.name)
+                mtime = path.stat().st_mtime
             except OSError:  # claimed/vanished mid-scan: order last,
                 # claim() skips it when the rename fails
-                return (float("inf"), path.name)
+                return (len(PRIORITY_CLASSES), float("inf"),
+                        float("inf"), path.name)
+            rank = _PRIORITY_RANK["normal"]
+            deadline = float("inf")
+            try:
+                doc = json.loads(path.read_bytes())
+                rank = _PRIORITY_RANK.get(
+                    doc.get("priority", "normal"), -1)
+                if doc.get("deadline_unix") is not None:
+                    deadline = float(doc["deadline_unix"])
+            except (OSError, ValueError, TypeError):
+                rank = -1  # unreadable: claim first -> parked as failed
+            return (rank, deadline, mtime, path.name)
 
         return sorted(root.glob("*.json"), key=_key)
 
     def depth(self) -> int:
         return len(self.pending())
 
-    def claim(self) -> Optional[RequestTicket]:
-        """Claim the oldest pending request, or None when the queue is
-        empty.  Rename-based: losing a claim race to another worker is
-        silent (the next candidate is tried)."""
+    def claim(self, predicate=None) -> Optional[RequestTicket]:
+        """Claim the best pending request (see :meth:`pending` for the
+        order), or None when the queue is empty.  Rename-based: losing
+        a claim race to another worker is silent (the next candidate is
+        tried).
+
+        ``predicate(ticket) -> bool`` filters candidates BEFORE the
+        claim rename — the batched worker's same-bucket-rung selection.
+        A ticket that cannot be parsed or carries an unknown priority
+        class bypasses the predicate so it still gets parked as failed
+        here instead of wedging every filtered claim."""
         for path in self.pending():
             target = self.root / "active" / path.name
+            peeked = None
+            parse_error = None
+            try:
+                peeked = RequestTicket.from_json(path.read_bytes())
+            except (OSError, ValueError, TypeError) as exc:
+                parse_error = exc
+            bad_priority = (peeked is not None
+                            and peeked.priority not in PRIORITY_CLASSES)
+            if (predicate is not None and parse_error is None
+                    and not bad_priority and not predicate(peeked)):
+                continue
             try:
                 # the pending file's mtime is the atomic-commit instant
                 # — the queue-wait span's start; read it BEFORE the
@@ -207,12 +294,17 @@ class SpoolQueue:
                 continue  # another worker won, or the ticket vanished
             try:
                 ticket = RequestTicket.from_json(target.read_bytes())
+                if ticket.priority not in PRIORITY_CLASSES:
+                    raise ValueError(
+                        f"unknown priority {ticket.priority!r} (one of "
+                        f"{PRIORITY_CLASSES})")
                 ticket.pending_mtime = mtime
                 ticket.claimed_unix = round(time.time(), 6)
                 return ticket
             except (OSError, ValueError, TypeError) as exc:
-                # a malformed ticket must not wedge the queue: park it
-                # as failed with the parse error recorded
+                # a malformed ticket — unparseable, or a priority class
+                # the admission order cannot place — must not wedge the
+                # queue: park it as failed with the error recorded
                 atomic_write_bytes(
                     self._ticket_path("failed", path.stem),
                     (json.dumps({"request_id": path.stem,

@@ -49,6 +49,23 @@ Two live surfaces ride on top (schema v8, OBSERVABILITY.md
   ``pert-serve status <spool>`` renders, the first way to ask a
   running worker "what are you doing right now and how long has it
   been stuck there".
+
+**Continuous batching** (``max_batch`` K > 1): the worker runs up to K
+requests as concurrent BLOCKS of one slab (serve/slab.py).  The claim
+predicate steers same-bucket-rung tickets in (their shape hints map to
+the rung the first live block pinned), so every block runs the SAME
+compiled programs — one resident program set serves the whole slab,
+and block dispatches interleave on the device.  A block that finishes
+retires from the slab immediately (its decode/stream-back ran while
+the others kept fitting) and its slot is refilled from the spool on
+the next claim — continuous batching, not gang scheduling.  Each block
+keeps per-request EVERYTHING via the thread-local observability seams
+(RunLog stack, metrics registry, fault plan), so per-request fault
+isolation is per-block isolation: an injected ``oom`` in one block
+fails that ticket only.  Priority/SLO admission is ticket-borne
+(``priority`` class + ``deadline_unix``, serve/queue.py).  Several
+workers may share one spool — the rename-claim protocol already
+arbitrates them — for multi-worker scale-out.
 """
 
 from __future__ import annotations
@@ -56,6 +73,7 @@ from __future__ import annotations
 import collections
 import contextlib
 import dataclasses
+import functools
 import itertools
 import json
 import os
@@ -77,6 +95,11 @@ from scdna_replication_tools_tpu.serve.buckets import (
 from scdna_replication_tools_tpu.serve.queue import (
     RequestTicket,
     SpoolQueue,
+)
+from scdna_replication_tools_tpu.infer import svi as svi_mod
+from scdna_replication_tools_tpu.serve.slab import (
+    SlabFitCoordinator,
+    SlabState,
 )
 from scdna_replication_tools_tpu.utils import faults as faults_mod
 from scdna_replication_tools_tpu.utils.fileio import atomic_write_bytes
@@ -115,6 +138,9 @@ class RequestOutcome:
     error: Optional[str] = None
     run_log: Optional[str] = None
     compile_cache: Optional[dict] = None
+    # batched mode: the request completed while >= 1 slab peer kept
+    # fitting (its decode/stream-back overlapped their fit time)
+    retired_early: bool = False
 
 
 class ServeWorker:
@@ -130,13 +156,28 @@ class ServeWorker:
                  max_requests: Optional[int] = None,
                  exit_when_idle: bool = False,
                  default_options: Optional[dict] = None,
-                 trace_spans: bool = True):
+                 trace_spans: bool = True,
+                 max_batch: int = 1):
         self.queue = queue
         self.buckets = buckets or BucketSet()
         self.poll_interval = float(poll_interval)
         self.max_requests = max_requests
         self.exit_when_idle = bool(exit_when_idle)
         self.default_options = dict(default_options or {})
+        # continuous batching width: K > 1 runs up to K same-rung
+        # requests as concurrent slab blocks (see module docstring);
+        # 1 keeps the strictly serial loop byte-identical to before
+        self.max_batch = int(max_batch)
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.slab = SlabState(self.max_batch)
+        # the slab FIT ENGINE: block threads hand their fit chunks to
+        # this coordinator (via svi.set_chunk_dispatcher), which packs
+        # concurrent same-signature chunks into one vectorized
+        # dispatch at a power-of-two width rung — see
+        # serve/slab.SlabFitCoordinator
+        self.slab_coordinator = (SlabFitCoordinator(self.max_batch)
+                                 if self.max_batch > 1 else None)
         # causal span tracing (obs/spans.py) — default ON for the
         # worker: serving is exactly where "where did the p99 go" needs
         # queue-wait/admission/fit/stream-back decomposed, and each
@@ -174,8 +215,18 @@ class ServeWorker:
         self._started_unix = round(time.time(), 3)
         self._processed = 0
         self._state = "starting"
-        self._inflight: Optional[dict] = None
-        self._request_tracer: Optional[spans_mod.SpanTracer] = None
+        # rid -> {"request_id", "started_unix"}: one entry in serial
+        # mode, up to max_batch in batched mode.  _state_lock guards
+        # it plus the tracer map, ledger and counters — block threads
+        # mutate all of them concurrently
+        self._inflight: dict = {}
+        self._request_tracers: dict = {}
+        # rid -> slab residency facts, snapshotted by the FIRST
+        # _slab_exit call (the request_end emit in batched mode) so
+        # the request-span close in process_request's finally reports
+        # the same numbers
+        self._slab_facts: dict = {}
+        self._state_lock = threading.RLock()
         self._bucket_ledger: dict = {}
         self._heartbeat_stop = threading.Event()
         queue.ensure_dirs()
@@ -199,6 +250,10 @@ class ServeWorker:
         # request's own log feeds its own — no cross-feeding even
         # though both are live in one process
         self.worker_log.metrics_registry = self.registry
+        # the slab gauges (manifest-pinned): configured width is
+        # static; occupancy moves on every admit/retire
+        self.registry.gauge("pert_serve_batch_width").set(self.max_batch)
+        self.registry.gauge("pert_serve_slab_occupancy").set(0)
 
     # -- lifecycle --------------------------------------------------------
 
@@ -238,6 +293,7 @@ class ServeWorker:
             "exit_when_idle": self.exit_when_idle,
             "default_options": self.default_options,
             "trace_spans": self.trace_spans,
+            "max_batch": self.max_batch,
         }
         heartbeat = threading.Thread(target=self._heartbeat_loop,
                                      name="pert-serve-status",
@@ -247,24 +303,10 @@ class ServeWorker:
         try:
             with self.worker_log.session(config=config,
                                          run_name="pert_serve"):
-                while not self._draining:
-                    if self.max_requests is not None \
-                            and self._processed >= self.max_requests:
-                        break
-                    self._set_state("idle")
-                    ticket = self.queue.claim()
-                    if ticket is None:
-                        if self.exit_when_idle:
-                            break
-                        self._sleep_poll()
-                        continue
-                    outcome = self.process_request(ticket)
-                    self.outcomes.append(outcome)
-                    self._status_counts[outcome.status] = \
-                        self._status_counts.get(outcome.status, 0) + 1
-                    self._processed += 1
-                    self.registry.write_textfile()
-                    self._write_status()
+                if self.max_batch > 1:
+                    self._drain_batched()
+                else:
+                    self._drain_serial()
         finally:
             # join the heartbeat BEFORE writing the terminal state: a
             # heartbeat mid-write when the stop flag lands would
@@ -285,6 +327,130 @@ class ServeWorker:
             "outcomes": [dataclasses.asdict(o) for o in self.outcomes],
         }
 
+    def _finish_outcome(self, outcome: RequestOutcome) -> None:
+        with self._state_lock:
+            self.outcomes.append(outcome)
+            self._status_counts[outcome.status] = \
+                self._status_counts.get(outcome.status, 0) + 1
+            self._processed += 1
+        self.registry.write_textfile()
+        self._write_status()
+
+    def _drain_serial(self) -> None:
+        """The strictly serial loop (``max_batch == 1``): claim, run,
+        repeat — one request in flight, ever."""
+        while not self._draining:
+            if self.max_requests is not None \
+                    and self._processed >= self.max_requests:
+                break
+            self._set_state("idle")
+            ticket = self.queue.claim()
+            if ticket is None:
+                if self.exit_when_idle:
+                    break
+                self._sleep_poll()
+                continue
+            self._finish_outcome(self.process_request(ticket))
+
+    # -- continuous batching ----------------------------------------------
+
+    def _slab_predicate(self):
+        """Claim filter while the slab has live blocks: admit tickets
+        whose shape hint lands in the slab's pinned bucket rung (one
+        compiled program set serves every block), plus hint-less
+        tickets (real admission decides — a mismatch merely makes a
+        second program family resident, it is never wrong).  With an
+        empty slab (rung None) there is nothing to match: claim the
+        best-priority ticket outright."""
+        rung = self.slab.rung
+        if rung is None:
+            return None
+
+        def _same_rung(ticket: RequestTicket) -> bool:
+            bucket = self.buckets.select_hint(ticket.shape)
+            return bucket is None or bucket.name == rung
+
+        return _same_rung
+
+    def _block_main(self, ticket: RequestTicket, box: dict) -> None:
+        """One slab block = one full request pipeline on its own
+        thread.  The thread-local seams (RunLog stack, metrics
+        registry, fault plan) scope every per-request install to this
+        block; the chunk dispatcher install routes this block's fit
+        chunks through the shared slab coordinator."""
+        try:
+            svi_mod.set_chunk_dispatcher(self.slab_coordinator)
+            try:
+                box["outcome"] = self.process_request(ticket)
+            finally:
+                svi_mod.set_chunk_dispatcher(None)
+        except BaseException as exc:  # pertlint: disable=PL011 — thread
+            # boundary, not a swallow: process_request only lets
+            # process-fatal BaseExceptions escape (it already called
+            # request_drain); the reaper re-raises ``box['error']`` on
+            # the worker thread, which owns reporting
+            box["error"] = exc
+
+    def _drain_batched(self) -> None:
+        """Continuous batching (``max_batch`` K > 1): keep up to K
+        block threads in flight, reap finished blocks as they retire,
+        refill vacated blocks from the spool — admission never waits
+        for the slab to drain (that would be gang scheduling)."""
+        active: dict = {}
+        claimed = 0
+
+        def _reap() -> None:
+            for rid in [r for r, blk in active.items()
+                        if not blk["thread"].is_alive()]:
+                block = active.pop(rid)
+                block["thread"].join()
+                error = block["box"].get("error")
+                if error is not None:
+                    # process-fatal escape (preemption/KeyboardInterrupt
+                    # in a block): drain — the loop exits once every
+                    # live block has been reaped
+                    logger.warning(
+                        "pert-serve: block %s died process-fatally "
+                        "(%s) — draining", rid, error)
+                    self.request_drain()
+                    continue
+                outcome = block["box"].get("outcome")
+                if outcome is not None:
+                    self._finish_outcome(outcome)
+
+        while True:
+            _reap()
+            budget_left = (self.max_requests is None
+                           or claimed < self.max_requests)
+            if self._draining or not budget_left:
+                if not active:
+                    break
+                time.sleep(0.05)
+                continue
+            if len(active) >= self.max_batch:
+                time.sleep(0.05)
+                continue
+            ticket = self.queue.claim(predicate=self._slab_predicate())
+            if ticket is None:
+                if not active:
+                    self._set_state("idle")
+                    if self.exit_when_idle:
+                        break
+                    self._sleep_poll()
+                else:
+                    # slab partially full, nothing claimable (empty
+                    # queue or all candidates off-rung): keep serving
+                    time.sleep(0.05)
+                continue
+            claimed += 1
+            box: dict = {}
+            thread = threading.Thread(
+                target=self._block_main, args=(ticket, box),
+                name=f"pert-serve-block-{ticket.request_id}",
+                daemon=True)
+            active[ticket.request_id] = {"thread": thread, "box": box}
+            thread.start()
+
     # -- the live status surface ------------------------------------------
 
     def _set_state(self, state: str) -> None:
@@ -301,22 +467,27 @@ class ServeWorker:
         while not self._heartbeat_stop.wait(interval):
             self._write_status()
 
+    def _inflight_doc(self, info: dict) -> dict:
+        doc = dict(info)
+        doc["age_seconds"] = round(
+            max(time.time() - doc.get("started_unix", 0.0), 0.0), 3)
+        tracer = self._request_tracers.get(doc.get("request_id"))
+        if tracer is not None:
+            # the WORKER-side open spans (request, and admission/
+            # stream_back while they run) with per-span ages.  The
+            # pipeline's own phase/chunk spans live on the request
+            # run's tracer and close as they complete — the last_span
+            # note below is what moves during the fit
+            doc["span_stack"] = tracer.stack()
+            doc["trace_id"] = tracer.trace_id
+        return doc
+
     def _status_doc(self) -> dict:
-        inflight = None
-        if self._inflight is not None:
-            inflight = dict(self._inflight)
-            inflight["age_seconds"] = round(
-                max(time.time() - inflight.get("started_unix", 0.0),
-                    0.0), 3)
-            tracer = self._request_tracer
-            if tracer is not None:
-                # the WORKER-side open spans (request, and admission/
-                # stream_back while they run) with per-span ages.  The
-                # pipeline's own phase/chunk spans live on the request
-                # run's tracer and close as they complete — the
-                # last_span note below is what moves during the fit
-                inflight["span_stack"] = tracer.stack()
-                inflight["trace_id"] = tracer.trace_id
+        with self._state_lock:
+            inflight_infos = [self._inflight_doc(info)
+                              for info in self._inflight.values()]
+        inflight = inflight_infos[0] if inflight_infos else None
+        if inflight is not None:
             last = spans_mod.last_closed_span()
             if last is not None:
                 # mid-fit progress: fit/chunk spans close every chunk,
@@ -327,6 +498,19 @@ class ServeWorker:
                     max(time.time() - last.get("end_unix", 0.0), 0.0),
                     3)
                 inflight["last_span"] = last
+        # slab membership: configured width, live occupancy, pinned
+        # rung, and every in-flight block (span stacks included) — in
+        # serial mode a one-block (or empty) slab, for a uniform
+        # surface
+        slab = self.slab.describe()
+        slab["blocks"] = inflight_infos
+        if self.slab_coordinator is not None:
+            # fit-engine counters: how much of the fitting actually ran
+            # packed (vs solo fallbacks at occupancy 1)
+            slab["fit_dispatches"] = self.slab_coordinator.dispatches
+            slab["packed_dispatches"] = \
+                self.slab_coordinator.packed_dispatches
+            slab["packed_lanes"] = self.slab_coordinator.packed_lanes
         return {
             "kind": "pert_serve_status",
             "pid": os.getpid(),
@@ -336,6 +520,7 @@ class ServeWorker:
             and self._state not in ("stopped",) else self._state,
             "queue_depth": self.queue.depth(),
             "in_flight": inflight,
+            "slab": slab,
             "processed": self._processed,
             "by_status": dict(self._status_counts),
             # bucket-residency ledger: which compiled shape families
@@ -405,9 +590,18 @@ class ServeWorker:
             tracer = spans_mod.SpanTracer(
                 trace_id=ticket.trace_id
                 or spans_mod.derive_trace_id(rid))
-            spans_mod.attach_tracer(self.worker_log, tracer)
+            if self.max_batch > 1:
+                # K concurrent request tracers cannot share the worker
+                # log's single tracer slot — wire each one's span sink
+                # straight to the log instead (span_end events still
+                # land there; the log-level span envelope is absent in
+                # batched mode)
+                spans_mod.attach_sink(self.worker_log, tracer)
+            else:
+                spans_mod.attach_tracer(self.worker_log, tracer)
             req_span = tracer.begin("request", request_id=rid)
-            self._request_tracer = tracer
+            with self._state_lock:
+                self._request_tracers[rid] = tracer
         # queue-wait: ticket commit (pending/ mtime) -> claim.  A real
         # span over an interval the worker never executed through —
         # the spool crossing — recorded retroactively from the claim
@@ -423,20 +617,65 @@ class ServeWorker:
                 tracer.record_span("queue_wait", float(q_start),
                                    float(ticket.claimed_unix),
                                    request_id=rid)
-        self._inflight = {"request_id": rid,
-                          "started_unix": round(time.time(), 3)}
+        with self._state_lock:
+            self._inflight[rid] = {"request_id": rid,
+                                   "started_unix": round(time.time(), 3)}
+        self.slab.admit(rid)
+        self.registry.gauge("pert_serve_slab_occupancy").set(
+            self.slab.occupancy())
         self._set_state("processing")
         try:
             return self._process_claimed(
                 ticket, rid, results_dir, t0, depth, options, bucket,
                 tracer, req_span, queue_wait)
         finally:
-            self._inflight = None
+            # idempotent: in batched mode the request_end emit already
+            # retired the block and cached the facts
+            facts = self._slab_exit(rid)
             if tracer is not None:
                 if req_span is not None:
-                    tracer.end(req_span)
-                spans_mod.attach_tracer(self.worker_log, None)
-                self._request_tracer = None
+                    if self.max_batch > 1:
+                        # the waterfall's attribution inputs ride the
+                        # request span (tools/pert_trace.py divides the
+                        # shared fit seconds by this occupancy)
+                        tracer.end(
+                            req_span,
+                            slab_avg_occupancy=facts["avg_occupancy"],
+                            retired_early=facts["retired_early"])
+                    else:
+                        tracer.end(req_span)
+                if self.max_batch <= 1:
+                    spans_mod.attach_tracer(self.worker_log, None)
+            with self._state_lock:
+                self._inflight.pop(rid, None)
+                self._request_tracers.pop(rid, None)
+                self._slab_facts.pop(rid, None)
+
+    def _slab_exit(self, rid: str) -> dict:
+        """Retire the block from the slab ledger — idempotent: the
+        first call snapshots the residency facts (avg_occupancy,
+        retired_early) and refreshes the occupancy gauge; later calls
+        in the same request return the snapshot."""
+        with self._state_lock:
+            facts = self._slab_facts.get(rid)
+            if facts is None:
+                facts = self.slab.retire(rid)
+                self._slab_facts[rid] = facts
+                self.registry.gauge("pert_serve_slab_occupancy").set(
+                    self.slab.occupancy())
+            return facts
+
+    def _slab_end_attrs(self, rid: str) -> dict:
+        """Extra ``request_end`` fields in batched mode: did the block
+        retire while >= 1 peer kept fitting, and its time-weighted
+        average slab occupancy (the waterfall's shared-fit-time
+        divisor).  Empty in serial mode so those worker logs stay
+        byte-identical to pre-batching ones."""
+        if self.max_batch <= 1:
+            return {}
+        facts = self._slab_exit(rid)
+        return {"retired_early": facts["retired_early"],
+                "slab_avg_occupancy": facts["avg_occupancy"]}
 
     def _process_claimed(self, ticket, rid, results_dir, t0, depth,
                          options, bucket, tracer, req_span,
@@ -466,8 +705,12 @@ class ServeWorker:
                 shape=shape)
             # bucket-residency ledger (status.json): admitted traffic
             # per compiled shape family this worker keeps warm
-            self._bucket_ledger[bucket.name] = \
-                self._bucket_ledger.get(bucket.name, 0) + 1
+            with self._state_lock:
+                self._bucket_ledger[bucket.name] = \
+                    self._bucket_ledger.get(bucket.name, 0) + 1
+            # the first admitted block's bucket pins the slab rung —
+            # the claim predicate steers same-rung tickets in after it
+            self.slab.set_bucket(rid, bucket.name)
         except BucketRefusal as exc:
             wall = time.perf_counter() - t0
             self.worker_log.emit(
@@ -476,14 +719,19 @@ class ServeWorker:
                 queue_wait_seconds=(round(queue_wait, 6)
                                     if queue_wait is not None else None),
                 detail="refused at admission")
+            slab_attrs = self._slab_end_attrs(rid)
             self.worker_log.emit(
                 "request_end", request_id=rid, status="refused",
-                wall_seconds=round(wall, 4), error=str(exc)[:500])
+                wall_seconds=round(wall, 4), error=str(exc)[:500],
+                **slab_attrs)
             self.queue.finish(ticket, "refused", error=str(exc),
                               results_dir=results_dir)
             logger.warning("pert-serve: request %s refused: %s", rid,
                            exc)
-            return self._record(rid, "refused", wall, error=str(exc))
+            return self._record(rid, "refused", wall, error=str(exc),
+                                retired_early=bool(
+                                    slab_attrs.get("retired_early",
+                                                   False)))
         except Exception as exc:
             # unreadable/malformed input: fail the request at
             # admission.  Still open the lifecycle pair — the worker
@@ -496,16 +744,21 @@ class ServeWorker:
                 queue_wait_seconds=(round(queue_wait, 6)
                                     if queue_wait is not None else None),
                 detail="failed at admission")
+            slab_attrs = self._slab_end_attrs(rid)
             self.worker_log.emit(
                 "request_end", request_id=rid, status="failed",
                 wall_seconds=round(wall, 4),
                 error=f"{type(exc).__name__}: {str(exc)[:400]}",
-                error_class="admission")
+                error_class="admission",
+                **slab_attrs)
             self.queue.finish(ticket, "failed", error=str(exc),
                               results_dir=results_dir)
             logger.warning("pert-serve: request %s failed at admission "
                            "(%s)", rid, exc)
-            return self._record(rid, "failed", wall, error=str(exc))
+            return self._record(rid, "failed", wall, error=str(exc),
+                                retired_early=bool(
+                                    slab_attrs.get("retired_early",
+                                                   False)))
 
         bucket_info = {"name": bucket.name, "cells": bucket.cells,
                        "loci": bucket.loci}
@@ -527,6 +780,7 @@ class ServeWorker:
             faults_mod.install(None)
             wall = time.perf_counter() - t0
             kind = faults_mod.classify_exception(exc)
+            slab_attrs = self._slab_end_attrs(rid)
             self.worker_log.emit(
                 "request_end", request_id=rid, status="failed",
                 wall_seconds=round(wall, 4), bucket=bucket_info,
@@ -536,7 +790,8 @@ class ServeWorker:
                 detail=("request isolated: the per-request durable-run "
                         "artifacts (checkpoints, RunLog, manifest) "
                         "carry the post-mortem; the worker and queue "
-                        "continue"))
+                        "continue"),
+                **slab_attrs)
             self.queue.finish(ticket, "failed",
                               error=f"{type(exc).__name__}: "
                                     f"{str(exc)[:400]}",
@@ -548,7 +803,10 @@ class ServeWorker:
                                 bucket=bucket_info,
                                 error=f"{type(exc).__name__}: "
                                       f"{str(exc)[:400]}",
-                                run_log=run_log_path)
+                                run_log=run_log_path,
+                                retired_early=bool(
+                                    slab_attrs.get("retired_early",
+                                                   False)))
         except BaseException:
             # a real preemption/KeyboardInterrupt: the PROCESS is going
             # away — record what we can and propagate (the ticket stays
@@ -563,11 +821,12 @@ class ServeWorker:
             for k in ("programs", "cache_hits", "cache_misses",
                       "hit_rate")
         }
+        slab_attrs = self._slab_end_attrs(rid)
         self.worker_log.emit(
             "request_end", request_id=rid, status="ok",
             wall_seconds=round(wall, 4), bucket=bucket_info,
             run_log=run_log_path, results_dir=str(results_dir),
-            compile_cache=compile_cache)
+            compile_cache=compile_cache, **slab_attrs)
         self.queue.finish(ticket, "ok", results_dir=results_dir)
         logger.info(
             "pert-serve: request %s ok in %.1fs (bucket %s, compile "
@@ -576,7 +835,9 @@ class ServeWorker:
             compile_cache.get("cache_misses"))
         return self._record(rid, "ok", wall, bucket=bucket_info,
                             run_log=run_log_path,
-                            compile_cache=compile_cache)
+                            compile_cache=compile_cache,
+                            retired_early=bool(
+                                slab_attrs.get("retired_early", False)))
 
     def _run_pipeline(self, rid: str, df_s, df_g1, options: dict,
                       bucket, results_dir, run_log_path: str,
@@ -599,6 +860,7 @@ class ServeWorker:
             pad_cells_to=bucket.cells,
             pad_loci_to=bucket.loci,
             request_id=rid,
+            slab_width=(self.max_batch if self.max_batch > 1 else None),
             **trace_kwargs,
             **options,
         )
@@ -643,8 +905,10 @@ class ServeWorker:
 
     def _record(self, rid: str, status: str, wall: float,
                 bucket=None, error=None, run_log=None,
-                compile_cache=None) -> RequestOutcome:
+                compile_cache=None,
+                retired_early: bool = False) -> RequestOutcome:
         return RequestOutcome(
             request_id=rid, status=status,
             wall_seconds=round(wall, 4), bucket=bucket, error=error,
-            run_log=run_log, compile_cache=compile_cache)
+            run_log=run_log, compile_cache=compile_cache,
+            retired_early=retired_early)

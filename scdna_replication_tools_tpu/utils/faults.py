@@ -300,24 +300,27 @@ class FaultPlan:
         return None
 
 
-_ACTIVE: Optional[FaultPlan] = None
+# the plan seam is THREAD-LOCAL, mirroring obs.runlog.current and
+# obs.metrics.current: a batched serving worker fits one request per
+# block thread, and a request's ``faults='oom@step2/fit#1'`` must fire
+# in that request's thread only — per-block fault isolation.
+_TLS = threading.local()
 
 
 def install(plan: Optional[FaultPlan]) -> None:
-    """Install (or clear, with None) the process-wide fault plan.
+    """Install (or clear, with None) this THREAD's fault plan.
 
-    Process-global on purpose: the injection sites live in layers
+    A seam on purpose: the injection sites live in layers
     (``infer/svi``'s chunk loop, the AOT compile path) that have no
     config plumbing, exactly like the RunLog's :func:`obs.runlog.current`
     seam.  The runner installs the plan its config names; tests install
     and clear around each case.
     """
-    global _ACTIVE
-    _ACTIVE = plan
+    _TLS.plan = plan
 
 
 def active() -> Optional[FaultPlan]:
-    return _ACTIVE
+    return getattr(_TLS, "plan", None)
 
 
 def resolve_plan(config_value: Optional[str]) -> Optional[FaultPlan]:
@@ -345,7 +348,7 @@ def point(site: str) -> Optional[str]:
     ``fault_injected`` RunLog event before acting, so the audit trail
     survives even the raising kinds.
     """
-    plan = _ACTIVE
+    plan = active()
     if plan is None:
         return None
     rule = plan.check(site, proc=_process_index())
@@ -499,9 +502,25 @@ def run_with_deadline(fn: Callable, seconds: Optional[float], label: str):
         return fn()
     box: dict = {}
     done = threading.Event()
+    # the watchdog runs fn in a FRESH thread, but the thread-local
+    # seams (RunLog stack, metrics registry, fault plan) belong to the
+    # caller — capture them here and install inside the worker so a
+    # compile event or fault point fired under the deadline still lands
+    # on the calling request's log/registry/plan
+    from scdna_replication_tools_tpu.obs import metrics as _metrics
+    from scdna_replication_tools_tpu.obs import runlog as _runlog
+
+    caller_stack = _runlog.stack_snapshot()
+    caller_registry = _metrics.current()
+    caller_plan = active()
 
     def _target():
         try:
+            _runlog.install_stack(caller_stack)
+            if caller_registry is not None \
+                    and getattr(caller_registry, "enabled", False):
+                _metrics.install(caller_registry)
+            install(caller_plan)
             box["value"] = fn()
         except BaseException as exc:  # pertlint: disable=PL011 — the
             # cross-thread re-raise: the waiter below raises box["error"]

@@ -206,7 +206,8 @@ def test_config_digest_invariant_to_non_hash_fields():
     moved = dataclasses.replace(
         base, telemetry_path="/elsewhere/run.ndjson",
         metrics_textfile="/elsewhere/metrics.prom",
-        request_id="req-42", trace_spans=True, trace_parent="aaaa:bbbb")
+        request_id="req-42", trace_spans=True, trace_parent="aaaa:bbbb",
+        slab_width=4)
     # the replacement above must exercise EVERY declared excluded field
     changed = {f for f in NON_HASH_FIELDS
                if getattr(moved, f) != getattr(base, f)}

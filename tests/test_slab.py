@@ -1,0 +1,308 @@
+"""Device-level slab program (`infer/svi._run_fit_chunk_slab`): the
+vmapped twin of the chunk fit program that continuous batching packs
+same-bucket requests into.
+
+The contract under test, on a toy quadratic loss (the real loss would
+only slow the pins down without changing the vmap semantics):
+
+* **bit parity** — a slab of W blocks advances each block EXACTLY as W
+  solo `_run_fit_chunk` dispatches would: params, losses and verdicts
+  bit-identical per block;
+* **frozen lanes** — a block whose ``stop == i0`` (retired/vacant) has
+  an immediately-false loop condition: its carry passes through
+  untouched, so a parked block costs nothing semantically;
+* **refill** — ``slab_fill`` functionally replaces one block
+  (``slab_pack``/``slab_block`` round-trip), and the refilled slab's
+  next dispatch advances the fresh block from ITS state while the
+  veterans continue from theirs;
+* **pallas refusal** — ``fused_adam='pallas*'`` raises at trace time
+  (the Pallas kernel's batching rule is unvalidated under vmap);
+* **coordinator** — serve/slab.SlabFitCoordinator rendezvous-packs
+  concurrent chunk dispatches: >= 2 same-signature calls advance on the
+  vectorized program, a lone call stays bit-identical with serial via
+  its solo program, and a slab-level failure degrades lane-by-lane.
+
+Numerics caveat (the documented serving contract, see
+OBSERVABILITY.md "Serving"): the vectorized program's fused update
+chain may differ from the solo program by ~1 ulp per step —
+value-dependent vector-width instruction selection on XLA:CPU — so
+packed-lane assertions pin tight ``allclose`` tolerances, not bitwise
+equality.  The bitwise pins below are the cases the system actually
+guarantees bit-exact: parked-lane passthrough and solo dispatch.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scdna_replication_tools_tpu.infer.svi import (
+    DIAG_RING,
+    ChunkCall,
+    _run_fit_chunk,
+    _run_fit_chunk_slab,
+    make_opt_state,
+    slab_block,
+    slab_fill,
+    slab_pack,
+)
+from scdna_replication_tools_tpu.serve.slab import SlabFitCoordinator
+
+MAX_ITER = 32
+CONV_WINDOW = 8
+N = 4  # toy parameter size
+
+
+def _toy_loss(params, target):
+    return jnp.sum((params["x"] - target) ** 2)
+
+
+def _block_state(seed):
+    """One block's full chunk-call state, deterministically from a
+    seed — rebuildable at will because the chunk programs DONATE
+    opt_state/losses/diag buffers."""
+    rng = np.random.RandomState(seed)
+    params = {"x": jnp.asarray(rng.randn(N), jnp.float32)}
+    opt_state = make_opt_state(params, learning_rate=0.05)
+    losses = jnp.zeros((MAX_ITER,), jnp.float32)
+    diag = jnp.zeros((DIAG_RING, 3), jnp.float32)
+    target = jnp.asarray(rng.randn(N), jnp.float32)
+    return params, opt_state, losses, diag, target
+
+
+def _solo_chunk(seed, i0, stop, min_iter=4):
+    params, opt_state, losses, diag, target = _block_state(seed)
+    return _run_fit_chunk(
+        _toy_loss, params, opt_state, losses, diag,
+        jnp.asarray(i0), jnp.asarray(stop), jnp.asarray(min_iter),
+        jnp.asarray(1e-9), jnp.asarray(0.05), (target,),
+        conv_window=CONV_WINDOW, b1=0.8, b2=0.99, diag_every=0)
+
+
+def _slab_chunk(seeds, i0s, stops, min_iters=None):
+    states = [_block_state(s) for s in seeds]
+    params = slab_pack([st[0] for st in states])
+    opt_state = slab_pack([st[1] for st in states])
+    losses = slab_pack([st[2] for st in states])
+    diag = slab_pack([st[3] for st in states])
+    targets = slab_pack([(st[4],) for st in states])
+    min_iters = min_iters or [4] * len(seeds)
+    return _run_fit_chunk_slab(
+        _toy_loss, params, opt_state, losses, diag,
+        list(i0s), list(stops), list(min_iters),
+        [1e-9] * len(seeds), [0.05] * len(seeds), targets,
+        conv_window=CONV_WINDOW, b1=0.8, b2=0.99, diag_every=0)
+
+
+def test_slab_blocks_match_solo_chunks():
+    seeds = (3, 11, 29)
+    out = _slab_chunk(seeds, i0s=[0, 0, 0], stops=[16, 16, 16])
+    i_s, params_s, _, losses_s, _, conv_s, nan_s = out
+    for b, seed in enumerate(seeds):
+        i, params, _, losses, _, conv, is_nan = _solo_chunk(seed, 0, 16)
+        assert int(i_s[b]) == int(i)
+        # packed lanes: ulp tolerance (module docstring), not bitwise
+        np.testing.assert_allclose(np.asarray(params_s["x"][b]),
+                                   np.asarray(params["x"]),
+                                   rtol=0, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(losses_s[b]),
+                                   np.asarray(losses),
+                                   rtol=1e-5, atol=1e-5)
+        assert bool(conv_s[b]) == bool(conv)
+        assert bool(nan_s[b]) == bool(is_nan)
+
+
+def test_slab_parks_retired_lane_untouched():
+    # lane 1 retired: stop == i0 -> its cond is immediately false and
+    # the carry must come back bit-identical while lanes 0/2 advance
+    seeds = (3, 11, 29)
+    out = _slab_chunk(seeds, i0s=[0, 5, 0], stops=[16, 5, 16])
+    _, params_s, _, losses_s, _, _, _ = out
+    parked_params, _, parked_losses, _, _ = _block_state(11)
+    np.testing.assert_array_equal(np.asarray(params_s["x"][1]),
+                                  np.asarray(parked_params["x"]))
+    np.testing.assert_array_equal(np.asarray(losses_s[1]),
+                                  np.asarray(parked_losses))
+    # live lanes still match their solo runs (packed-lane tolerance)
+    for b, seed in ((0, 3), (2, 29)):
+        _, params, _, losses, _, _, _ = _solo_chunk(seed, 0, 16)
+        np.testing.assert_allclose(np.asarray(params_s["x"][b]),
+                                   np.asarray(params["x"]),
+                                   rtol=0, atol=1e-6)
+
+
+def test_slab_refill_advances_fresh_block_from_its_own_state():
+    # chunk 1: blocks (3, 11); block 1 then retires and is refilled
+    # with request 29's fresh state; chunk 2 must advance block 0 from
+    # its chunk-1 carry and block 1 exactly as 29's first solo chunk
+    states = [_block_state(3), _block_state(11)]
+    params = slab_pack([st[0] for st in states])
+    opt_state = slab_pack([st[1] for st in states])
+    losses = slab_pack([st[2] for st in states])
+    diag = slab_pack([st[3] for st in states])
+    targets = slab_pack([(st[4],) for st in states])
+    i_s, params, opt_state, losses, diag, _, _ = _run_fit_chunk_slab(
+        _toy_loss, params, opt_state, losses, diag,
+        [0, 0], [8, 8], [4, 4], [1e-9, 1e-9], [0.05, 0.05], targets,
+        conv_window=CONV_WINDOW, b1=0.8, b2=0.99, diag_every=0)
+    assert [int(v) for v in i_s] == [8, 8]
+
+    fresh_params, fresh_opt, fresh_losses, fresh_diag, fresh_target = \
+        _block_state(29)
+    params = slab_fill(params, 1, fresh_params)
+    opt_state = slab_fill(opt_state, 1, fresh_opt)
+    losses = slab_fill(losses, 1, fresh_losses)
+    diag = slab_fill(diag, 1, fresh_diag)
+    targets = slab_fill(targets, 1, (fresh_target,))
+    i_s, params2, _, losses2, _, _, _ = _run_fit_chunk_slab(
+        _toy_loss, params, opt_state, losses, diag,
+        [8, 0], [16, 8], [4, 4], [1e-9, 1e-9], [0.05, 0.05], targets,
+        conv_window=CONV_WINDOW, b1=0.8, b2=0.99, diag_every=0)
+    assert [int(v) for v in i_s] == [16, 8]
+
+    # veteran block 0 == solo run straight to 16 (packed tolerance)
+    _, solo_params, _, solo_losses, _, _, _ = _solo_chunk(3, 0, 16)
+    np.testing.assert_allclose(np.asarray(params2["x"][0]),
+                               np.asarray(solo_params["x"]),
+                               rtol=0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(losses2[0]),
+                               np.asarray(solo_losses),
+                               rtol=1e-5, atol=1e-5)
+    # refilled block 1 == request 29's own first chunk
+    _, solo_params, _, solo_losses, _, _, _ = _solo_chunk(29, 0, 8)
+    np.testing.assert_allclose(
+        np.asarray(slab_block(params2, 1)["x"]),
+        np.asarray(solo_params["x"]), rtol=0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(losses2[1]),
+                               np.asarray(solo_losses),
+                               rtol=1e-5, atol=1e-5)
+
+
+# -- SlabFitCoordinator: the cross-thread rendezvous -----------------------
+
+_SK = dict(conv_window=CONV_WINDOW, b1=0.8, b2=0.99, diag_every=0)
+
+
+def _chunk_call(seed, i0=0, stop=16, min_iter=4):
+    params, opt_state, losses, diag, target = _block_state(seed)
+    args = (params, opt_state, losses, diag,
+            jnp.asarray(i0), jnp.asarray(stop), jnp.asarray(min_iter),
+            jnp.asarray(1e-9), jnp.asarray(0.05), (target,))
+    return ChunkCall(
+        loss_fn=_toy_loss, args=args, static_kwargs=dict(_SK),
+        solo=lambda a: _run_fit_chunk(_toy_loss, *a, **_SK))
+
+
+def _dispatch_in_thread(coord, call, box, key):
+    try:
+        box[key] = coord.dispatch(call)
+    except BaseException as exc:  # surfaced by the test body
+        box[key] = exc
+
+
+def _rendezvous(coord, calls):
+    """Register every fitter BEFORE any dispatch (in the worker each
+    block thread brackets a whole multi-chunk fit, so peers are
+    registered long before the next chunk; here each thread has exactly
+    one chunk and would otherwise race past the barrier)."""
+    box = {}
+    for _ in calls:
+        coord.fit_begin()
+    threads = [
+        threading.Thread(target=_dispatch_in_thread,
+                         args=(coord, call, box, key))
+        for key, call in calls.items()
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    for _ in calls:
+        coord.fit_end()
+    return box
+
+
+def test_coordinator_packs_concurrent_dispatches():
+    coord = SlabFitCoordinator(2, window_seconds=2.0)
+    box = _rendezvous(coord, {seed: _chunk_call(seed)
+                              for seed in (3, 11)})
+    assert coord.packed_dispatches == 1
+    assert coord.packed_lanes == 2
+    for seed in (3, 11):
+        out = box[seed]
+        assert not isinstance(out, BaseException), out
+        i, params, _, losses, _, conv, is_nan = out
+        si, sp, _, sl, _, sconv, snan = _solo_chunk(seed, 0, 16)
+        assert int(i) == int(si)
+        np.testing.assert_allclose(np.asarray(params["x"]),
+                                   np.asarray(sp["x"]),
+                                   rtol=0, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(losses), np.asarray(sl),
+                                   rtol=1e-5, atol=1e-5)
+        assert bool(conv) == bool(sconv) and bool(is_nan) == bool(snan)
+
+
+def test_coordinator_singleton_stays_bit_exact():
+    # a lone fitter's chunk must go through its solo program — the
+    # occupancy-1 bit-identity guarantee — and never the slab program
+    coord = SlabFitCoordinator(2, window_seconds=0.05)
+    coord.fit_begin()
+    box = {}
+    _dispatch_in_thread(coord, _chunk_call(3), box, 3)
+    coord.fit_end()
+    assert coord.packed_dispatches == 0
+    assert coord.dispatches == 1
+    out = box[3]
+    assert not isinstance(out, BaseException), out
+    _, params, _, losses, _, _, _ = out
+    _, sp, _, sl, _, _, _ = _solo_chunk(3, 0, 16)
+    np.testing.assert_array_equal(np.asarray(params["x"]),
+                                  np.asarray(sp["x"]))
+    np.testing.assert_array_equal(np.asarray(losses), np.asarray(sl))
+
+
+def test_coordinator_slab_failure_degrades_lane_by_lane():
+    # poison the slab as a unit: pallas fused_adam raises at slab trace
+    # time, so the leader must fall back to per-lane solo dispatches —
+    # and only the lane whose own solo ALSO fails surfaces an error
+    coord = SlabFitCoordinator(2, window_seconds=2.0)
+
+    def poison_solo(a):
+        raise RuntimeError("lane poison")
+
+    # both lanes share a slab-refused static (pallas) so they group
+    # together AND the packed dispatch raises as a unit; their solo
+    # paths drop the static, so the fallback exercises real isolation
+    good = _chunk_call(3)
+    bad = _chunk_call(11)
+    good.static_kwargs["fused_adam"] = "pallas"
+    bad.static_kwargs["fused_adam"] = "pallas"
+    bad.solo = poison_solo
+
+    box = _rendezvous(coord, {"good": good, "bad": bad})
+    assert coord.packed_dispatches == 0  # slab refused, nothing packed
+    assert isinstance(box["bad"], RuntimeError)
+    assert "lane poison" in str(box["bad"])
+    out = box["good"]
+    assert not isinstance(out, BaseException), out
+    _, params, _, losses, _, _, _ = out
+    _, sp, _, sl, _, _, _ = _solo_chunk(3, 0, 16)
+    np.testing.assert_array_equal(np.asarray(params["x"]),
+                                  np.asarray(sp["x"]))
+
+
+def test_slab_refuses_pallas_fused_adam():
+    states = [_block_state(3), _block_state(11)]
+    params = slab_pack([st[0] for st in states])
+    opt_state = slab_pack([st[1] for st in states])
+    losses = slab_pack([st[2] for st in states])
+    diag = slab_pack([st[3] for st in states])
+    targets = slab_pack([(st[4],) for st in states])
+    with pytest.raises(ValueError, match="pallas"):
+        _run_fit_chunk_slab(
+            _toy_loss, params, opt_state, losses, diag,
+            [0, 0], [8, 8], [4, 4], [1e-9, 1e-9], [0.05, 0.05],
+            targets, conv_window=CONV_WINDOW, b1=0.8, b2=0.99,
+            diag_every=0, fused_adam="pallas")

@@ -334,9 +334,11 @@ def request_waterfall(worker_log, request_log=None,
     the worker side once instead of N times."""
     out = {c: 0.0 for c in WATERFALL_COMPONENTS}
     total = None
+    slab_occupancy = None
+    retired_early = None
 
     def _consume(spans, only_request: Optional[str]):
-        nonlocal total
+        nonlocal total, slab_occupancy, retired_early
         for ev in spans:
             attrs = ev.get("attrs") or {}
             if only_request and attrs.get("request_id") \
@@ -346,6 +348,12 @@ def request_waterfall(worker_log, request_log=None,
             if name == "request" and (not only_request or attrs.get(
                     "request_id") == only_request):
                 total = float(ev.get("duration_seconds") or 0.0)
+                # batched-serving attribution inputs (the worker's
+                # request-span close stamps them when max_batch > 1)
+                if attrs.get("slab_avg_occupancy") is not None:
+                    slab_occupancy = float(attrs["slab_avg_occupancy"])
+                if attrs.get("retired_early") is not None:
+                    retired_early = bool(attrs["retired_early"])
                 continue
             comp = classify_span(name, attrs)
             if comp is not None:
@@ -360,6 +368,19 @@ def request_waterfall(worker_log, request_log=None,
     waterfall = {c: round(v, 4) for c, v in out.items()}
     waterfall["total_seconds"] = round(total, 4) \
         if total is not None else None
+    if slab_occupancy is not None:
+        # continuous batching: K blocks' fit spans cover the SAME
+        # device seconds, so summing raw fit time across a slab
+        # double-counts.  fit_attributed divides each request's fit
+        # wall by its time-weighted slab occupancy — the per-request
+        # share of the shared fit time; summing IT across the slab
+        # recovers the device wall once.  Raw ``fit`` stays as-is
+        # (it is the request's own latency experience).
+        waterfall["slab_avg_occupancy"] = round(slab_occupancy, 4)
+        waterfall["fit_attributed"] = round(
+            waterfall["fit"] / max(slab_occupancy, 1.0), 4)
+        if retired_early is not None:
+            waterfall["retired_early"] = retired_early
     return waterfall
 
 

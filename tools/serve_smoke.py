@@ -17,6 +17,18 @@ One worker session over four queued requests:
 4. **r4 (mismatched shape)** — larger than the worker's largest
    bucket: refused at admission, never compiled.
 
+Two follow-on sessions ride the now-warm program cache:
+
+5. **batched** — a ``max_batch=2`` worker over three same-bucket
+   requests: the first two pack one device slab (the coordinator's
+   packed-dispatch counter proves it), the first to converge retires
+   early (``retired_early`` on its outcome) and the third request
+   REFILLS the vacated block mid-slab; the third request's RunLog
+   must be a zero-miss cache hit (the slab program compiled once,
+   for the whole session);
+6. **shared spool** — two workers drain ONE spool concurrently:
+   rename-based claiming means each request lands exactly once.
+
 Writes a JSON verdict (``--out``), copies r3's RunLog to
 ``<workdir>/warm_request.jsonl`` (the CI fleet-regress step gates its
 compile-cache metrics against the committed
@@ -182,6 +194,90 @@ def main(argv=None) -> int:
     check((queue.results_dir(r3) / "cell_qc.tsv").exists(),
           "r3 per-request cell_qc table streamed back")
 
+    # -- batched session: slab packing, early retirement, refill ----------
+    # three same-bucket requests through a max_batch=2 worker: b1+b2
+    # pack one slab, the first to converge retires early, b3 joins by
+    # refilling the vacated block.  Rides the warm solo ledger from
+    # the base session; the W=2 slab program compiles ONCE here, so
+    # b3 (admitted after that compile) must still be a zero-miss hit.
+    bq = SpoolQueue(workdir / "spool_batched")
+    b1 = bq.submit_frames(*sim_a, options=REQUEST_OPTIONS,
+                          request_id="b1_slab")
+    b2 = bq.submit_frames(*sim_b, options=REQUEST_OPTIONS,
+                          request_id="b2_slab")
+    b3 = bq.submit_frames(*sim_a, options=REQUEST_OPTIONS,
+                          request_id="b3_refill")
+    bworker = ServeWorker(bq, buckets=buckets, max_requests=3,
+                          exit_when_idle=True, max_batch=2)
+    bstats = bworker.run()
+    b_by_id = {o["request_id"]: o for o in bstats["outcomes"]}
+    check(all(b_by_id.get(r, {}).get("status") == "ok"
+              for r in (b1, b2, b3)),
+          "batched: all three slab requests ok")
+    coord = bworker.slab_coordinator
+    check(coord is not None and coord.packed_dispatches > 0,
+          "batched: the coordinator packed fits into slab dispatches")
+    check(any(o.get("retired_early") for o in bstats["outcomes"]),
+          "batched: a converged block retired early (peers kept "
+          "fitting)")
+    # the refilled request rides the session's warm ledgers: its own
+    # RunLog must not recompile any request-level program.  A slab-
+    # tagged miss is tolerated — whichever thread happens to LEAD the
+    # first packed dispatch of a step carries that one-time compile in
+    # its ledger (compile events carry `tag`; `slab<W>` marks the
+    # W-wide batched program rung)
+    b3_cache = b_by_id.get(b3, {}).get("compile_cache") or {}
+    b3_log = b_by_id.get(b3, {}).get("run_log")
+    b3_nonslab_misses = []
+    if b3_log:
+        with open(b3_log) as fh:
+            for line in fh:
+                ev = json.loads(line)
+                if (ev.get("event") == "compile"
+                        and ev.get("cache") == "miss"
+                        and not str(ev.get("tag", "")
+                                    ).startswith("slab")):
+                    b3_nonslab_misses.append(ev.get("tag"))
+    check(b3_log is not None and not b3_nonslab_misses,
+          "batched: the refilled request recompiles nothing but (at "
+          f"most) the shared slab program (non-slab misses: "
+          f"{b3_nonslab_misses})")
+    check(validate_run(bstats["worker_log"]) == [],
+          "batched: worker RunLog is schema-valid")
+
+    # -- shared spool: two workers, one queue -----------------------------
+    import threading
+
+    sq = SpoolQueue(workdir / "spool_shared")
+    s1 = sq.submit_frames(*sim_a, options=REQUEST_OPTIONS,
+                          request_id="s1_shared")
+    s2 = sq.submit_frames(*sim_b, options=REQUEST_OPTIONS,
+                          request_id="s2_shared")
+    sworkers = [ServeWorker(sq, buckets=buckets, max_requests=1,
+                            exit_when_idle=True) for _ in range(2)]
+    sstats = [None, None]
+
+    def _drain(i):
+        sstats[i] = sworkers[i].run()
+
+    sthreads = [threading.Thread(target=_drain, args=(i,))
+                for i in range(2)]
+    for t in sthreads:
+        t.start()
+    for t in sthreads:
+        t.join(timeout=600)
+    shared_ok = (sstats[0] is not None and sstats[1] is not None)
+    served_ids = []
+    if shared_ok:
+        for st in sstats:
+            served_ids += [o["request_id"] for o in st["outcomes"]]
+    check(shared_ok and sorted(served_ids) == sorted([s1, s2]),
+          "shared spool: two workers drained one queue, each request "
+          "claimed exactly once")
+    check(shared_ok and all(
+        o["status"] == "ok" for st in sstats for o in st["outcomes"]),
+        "shared spool: both requests ok")
+
     # stable copy of the warm request's log for the CI fleet gate
     if r3_log:
         shutil.copy(r3_log, workdir / "warm_request.jsonl")
@@ -202,6 +298,17 @@ def main(argv=None) -> int:
         "warm_request_log": str(workdir / "warm_request.jsonl"),
         "parity": {"tau_bit_identical": tau_equal,
                    "cn_identical": cn_equal},
+        "batched": {
+            "by_status": bstats["by_status"],
+            "packed_dispatches": getattr(coord, "packed_dispatches",
+                                         0),
+            "packed_lanes": getattr(coord, "packed_lanes", 0),
+            "retired_early": sum(
+                1 for o in bstats["outcomes"]
+                if o.get("retired_early")),
+            "refill_compile_cache": b3_cache,
+        },
+        "shared_spool": {"served": sorted(served_ids)},
     }
     print(json.dumps(verdict))
     if args.out:
