@@ -437,6 +437,17 @@ def _parse_args(argv=None):
     ap.add_argument("--serve-max-batch", type=int, default=4,
                     help="slab width K of the batched burst arm "
                          "(--depth)")
+    ap.add_argument("--restart", action="store_true",
+                    help="with --serve-ab: the zero-compile cold-start "
+                         "A/B — worker A drains N requests (persisting "
+                         "every compiled executable into the spool's "
+                         "exec store), then a FRESH-PROCESS worker B "
+                         "serves one more same-bucket request, which "
+                         "must pay zero XLA compiles (every program "
+                         "deserializes from disk); also runs the CLI "
+                         "twice with --executable-cache to record the "
+                         "cold-start cut a persisted store buys a "
+                         "one-shot CLI user")
     ap.add_argument("--enum-ab", action="store_true",
                     help="run the CN-encoding A/B instead of the SVI "
                          "microbench: the step-2 fit (production "
@@ -1268,6 +1279,212 @@ def run_serve_ab(args):
 
 
 # ---------------------------------------------------------------------------
+# --serve-ab --restart: zero-compile cold starts off the executable store
+# ---------------------------------------------------------------------------
+
+# worker B runs in a genuinely fresh interpreter: empty in-process
+# program cache, empty jit trace cache — the only warmth it can find
+# is the on-disk executable store worker A left in the spool
+_RESTART_WORKER_SCRIPT = """
+import json, pathlib, sys
+from scdna_replication_tools_tpu.serve import ServeWorker, SpoolQueue
+
+queue = SpoolQueue(pathlib.Path(sys.argv[1]))
+worker = ServeWorker(queue, max_requests=1, exit_when_idle=True)
+stats = worker.run()
+print("RESTART_OUTCOME " + json.dumps(
+    {"outcomes": stats["outcomes"], "worker_log": stats["worker_log"]}))
+"""
+
+
+def _deserialize_seconds_of(run_log):
+    """Total deserialize time across a run log's compile events."""
+    total, hits = 0.0, 0
+    try:
+        with open(run_log) as fh:
+            for line in fh:
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if (ev.get("event") == "compile"
+                        and ev.get("cache") == "disk_hit"):
+                    hits += 1
+                    total += float(ev.get("deserialize_seconds") or 0.0)
+    except OSError:
+        pass
+    return hits, round(total, 4)
+
+
+def run_serve_restart(args):
+    """``--serve-ab --restart``: the executable-store cold-start A/B.
+
+    Worker A drains N requests, persisting every compiled executable
+    into the spool's store (the worker's ``--executable-cache auto``
+    default).  Worker B — a FRESH interpreter — then serves one more
+    same-bucket request: its ledger must show zero compile misses and
+    only disk hits, and its service wall is compared against worker
+    A's warm p50 (the deserialize tax is milliseconds against a
+    multi-second XLA compile).  A second stage runs the one-shot CLI
+    twice against a shared ``--executable-cache``: run 2's wall is the
+    cold-start cut a persisted store buys users who never keep a
+    resident worker."""
+    import tempfile
+
+    if args.platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from scdna_replication_tools_tpu.obs.summary import summarize_run
+    from scdna_replication_tools_tpu.serve import ServeWorker, SpoolQueue
+
+    cohorts, options = _serve_ab_workload(args)
+    workdir = pathlib.Path(tempfile.mkdtemp(prefix="pert_serve_rst_"))
+    spool = workdir / "spool"
+
+    # -- worker A: populate the store, measure the warm floor ---------
+    queue = SpoolQueue(spool)
+    for df_s, df_g in cohorts:
+        queue.submit_frames(df_s, df_g, options=options)
+    worker_a = ServeWorker(queue, max_requests=len(cohorts),
+                           exit_when_idle=True)
+    t0 = time.perf_counter()
+    stats_a = worker_a.run()
+    a_total = time.perf_counter() - t0
+    ok_a = [o for o in stats_a["outcomes"] if o["status"] == "ok"]
+    if len(ok_a) != len(cohorts):
+        raise RuntimeError(f"worker A: {len(cohorts) - len(ok_a)} of "
+                           f"{len(cohorts)} requests did not land ok: "
+                           f"{stats_a['by_status']}")
+    warm_lat = [o["wall_seconds"] for o in ok_a[1:]]  # drop the cold one
+    warm_p50 = _percentile(warm_lat, 50)
+    store_entries = sorted((spool / "exec_cache").glob("*.pertexec"))
+    assert store_entries, ("worker A persisted no executables — the "
+                           "serve worker's exec store default is off")
+
+    # -- worker B: fresh interpreter over the warmed spool ------------
+    queue.submit_frames(*cohorts[0], options=options)
+    env = dict(os.environ)
+    if args.platform == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, "-c", _RESTART_WORKER_SCRIPT, str(spool)],
+        env=env, capture_output=True, text=True)
+    b_process_wall = time.perf_counter() - t0
+    if proc.returncode != 0:
+        raise RuntimeError(f"restarted worker failed "
+                           f"(rc={proc.returncode}): {proc.stderr[-600:]}")
+    payload = next(line for line in proc.stdout.splitlines()
+                   if line.startswith("RESTART_OUTCOME "))
+    stats_b = json.loads(payload[len("RESTART_OUTCOME "):])
+    rst = stats_b["outcomes"][0]
+    assert rst["status"] == "ok", f"restart request not ok: {rst}"
+    rst_cache = rst.get("compile_cache") or {}
+    assert (rst_cache.get("cache_misses") or 0) == 0 \
+        and (rst_cache.get("disk_hits") or 0) > 0, (
+        "restarted worker's first request recompiled instead of "
+        f"disk-hitting the store: {rst_cache}")
+    disk_hits, deser_s = _deserialize_seconds_of(rst["run_log"])
+
+    # -- one-shot CLI, twice, sharing an executable store -------------
+    cli_dir = workdir / "cli"
+    cli_dir.mkdir(parents=True, exist_ok=True)
+    df_s, df_g = cohorts[0]
+    s_path, g_path = cli_dir / "s.tsv", cli_dir / "g1.tsv"
+    df_s.to_csv(s_path, sep="\t", index=False)
+    df_g.to_csv(g_path, sep="\t", index=False)
+    cli_runs = []
+    for i in (1, 2):
+        log_path = cli_dir / f"run{i}.jsonl"
+        argv = [sys.executable, "-c",
+                "from scdna_replication_tools_tpu.cli import "
+                "infer_scrt_main; infer_scrt_main()",
+                str(s_path), str(g_path),
+                str(cli_dir / f"out{i}.tsv"),
+                str(cli_dir / f"supp{i}.tsv"),
+                "--max-iter", str(options["max_iter"]),
+                "--cn-prior-method", options["cn_prior_method"],
+                "--no-mirror-rescue",
+                "--executable-cache", str(cli_dir / "exec_cache"),
+                "--telemetry", str(log_path)]
+        t0 = time.perf_counter()
+        proc = subprocess.run(argv, env=env, capture_output=True,
+                              text=True)
+        wall = time.perf_counter() - t0
+        if proc.returncode != 0:
+            raise RuntimeError(f"CLI run {i} failed "
+                               f"(rc={proc.returncode}): "
+                               f"{proc.stderr[-400:]}")
+        comp = (summarize_run(log_path) or {}).get("compile") or {}
+        cli_runs.append({"wall_seconds": round(wall, 2),
+                         "compile": comp})
+    assert (cli_runs[1]["compile"].get("cache_misses") or 0) == 0 \
+        and (cli_runs[1]["compile"].get("disk_hits") or 0) > 0, (
+        "CLI run 2 recompiled despite the shared --executable-cache: "
+        f"{cli_runs[1]['compile']}")
+
+    result = {
+        "metric": "pert_serve_restart_ab",
+        "workload": {
+            "requests": len(cohorts),
+            "cells_per_clone": args.serve_cells_per_clone,
+            "num_loci": args.serve_loci,
+            "max_iter": options["max_iter"],
+            "num_reads": args.ab_num_reads,
+            "simulation_seed": args.ab_seed,
+        },
+        "platform": jax.devices()[0].platform,
+        "worker_a": {
+            "requests": len(ok_a),
+            "total_wall_seconds": round(a_total, 2),
+            "cold_first_seconds": round(ok_a[0]["wall_seconds"], 2),
+            "warm_p50_seconds": round(warm_p50, 2),
+            "store_entries": len(store_entries),
+            "store_bytes": sum(p.stat().st_size for p in store_entries),
+        },
+        "worker_b_restart": {
+            "first_request_seconds": round(rst["wall_seconds"], 2),
+            "process_wall_seconds": round(b_process_wall, 2),
+            "compile_cache": rst_cache,
+            "disk_hits": disk_hits,
+            "deserialize_seconds": deser_s,
+            "vs_warm_p50": round(rst["wall_seconds"]
+                                 / max(warm_p50, 1e-9), 2),
+            "vs_cold_first": round(ok_a[0]["wall_seconds"]
+                                   / max(rst["wall_seconds"], 1e-9), 2),
+        },
+        "cli_cold_start": {
+            "run1": cli_runs[0],
+            "run2": cli_runs[1],
+            "speedup": round(cli_runs[0]["wall_seconds"]
+                             / max(cli_runs[1]["wall_seconds"], 1e-9),
+                             2),
+        },
+        "note": "worker A's first request compiles and persists the "
+                "bucket's executables (cold_first); a RESTARTED worker "
+                "(fresh interpreter, empty in-process caches) then "
+                "serves the same bucket paying only the deserialize "
+                "tax — vs_warm_p50 is its service wall against worker "
+                "A's steady state, vs_cold_first the cold compile it "
+                "skipped.  cli_cold_start is the same story for "
+                "one-shot CLI users: run 2 shares run 1's store, so "
+                "its wall drops by the whole trace+compile phase.",
+    }
+    print(json.dumps(result))
+    if args.ab_out:
+        pathlib.Path(args.ab_out).parent.mkdir(parents=True,
+                                               exist_ok=True)
+        with open(args.ab_out, "w") as fh:
+            json.dump(result, fh, indent=1)
+            fh.write("\n")
+    return result
+
+
+# ---------------------------------------------------------------------------
 # --enum-ab: CN-encoding A/B on the production fit path
 # ---------------------------------------------------------------------------
 
@@ -1423,7 +1640,9 @@ def main():
         return
 
     if args.serve_ab:
-        if args.depth:
+        if args.restart:
+            run_serve_restart(args)
+        elif args.depth:
             run_serve_burst(args)
         else:
             run_serve_ab(args)

@@ -130,7 +130,8 @@ class scRT:
                  enum_impl='auto', fused_adam='auto',
                  optimizer_state_dtype='float32', cn_hmm_self_prob=None,
                  rho_from_rt_prior=False, mirror_rescue=True,
-                 compile_cache_dir='auto', telemetry_path='auto',
+                 compile_cache_dir='auto', executable_cache_dir=None,
+                 telemetry_path='auto',
                  metrics_textfile=None, fit_diag_every=25,
                  qc=True, qc_entropy_thresh=0.5, qc_frac_thresh=0.25,
                  qc_ppc_replicates=8, qc_ppc_z=5.0,
@@ -178,6 +179,7 @@ class scRT:
             rho_from_rt_prior=rho_from_rt_prior,
             mirror_rescue=mirror_rescue,
             compile_cache_dir=compile_cache_dir,
+            executable_cache_dir=executable_cache_dir,
             telemetry_path=telemetry_path,
             metrics_textfile=metrics_textfile,
             fit_diag_every=fit_diag_every,

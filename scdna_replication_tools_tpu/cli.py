@@ -138,6 +138,14 @@ def infer_scrt_main(argv=None):
                         "'auto' (default, repo-local .jax_cache), a path, "
                         "or 'none' to disable "
                         "(PertConfig.compile_cache_dir)")
+    p.add_argument("--executable-cache", default=None,
+                   help="persistent AOT executable cache directory "
+                        "(infer/aotcache.py): serialized compiled "
+                        "executables keyed by the FL004-certified "
+                        "cross-process digest, so a repeated run "
+                        "deserializes instead of invoking XLA "
+                        "(zero-compile cold starts); default off "
+                        "(PertConfig.executable_cache_dir)")
     p.add_argument("--telemetry", default="auto",
                    help="structured JSONL run log: 'auto' (default, a "
                         "timestamped file under repo-local .pert_runs/), "
@@ -208,6 +216,7 @@ def infer_scrt_main(argv=None):
                 trace_parent=args.trace_parent,
                 mirror_rescue=args.mirror_rescue,
                 compile_cache_dir=args.compile_cache,
+                executable_cache_dir=args.executable_cache,
                 telemetry_path=args.telemetry,
                 metrics_textfile=args.metrics_textfile,
                 qc=args.qc, qc_entropy_thresh=args.qc_entropy_thresh,

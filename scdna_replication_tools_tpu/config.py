@@ -35,6 +35,28 @@ NON_HASH_FIELDS = (
     "trace_spans",          # tracing on/off is pure observability
     "trace_parent",         # per-request trace handoff
     "slab_width",           # serving-slab placement, not workload
+    "executable_cache_dir",  # WHERE executables persist, not which —
+                             # the AOT store's own key embeds this
+                             # config digest, so hashing the store
+                             # location would self-invalidate a moved
+                             # store (infer/aotcache.py key contract)
+)
+
+# Fields that legitimately belong in the config content hash (they
+# change RUN behaviour — resume state, artifact locations) but can
+# never shape a COMPILED PROGRAM: they name where host-side artifacts
+# land, not what XLA compiles.  The persistent executable store
+# (infer/aotcache.py) strips them — on top of NON_HASH_FIELDS — from
+# the config digest inside its cache key.  Without this, the serve
+# worker's per-request ``checkpoint_dir`` (``results/<id>/ckpt``)
+# would give every request a distinct AOT digest and a restarted
+# worker could never disk-hit its predecessor's executables.  Keep it
+# a literal tuple: the flow linter reads it statically alongside
+# NON_HASH_FIELDS.
+AOT_EXECUTION_ONLY_FIELDS = (
+    "checkpoint_dir",       # where checkpoints land (per-request in serve)
+    "profile_dir",          # where profiler dumps land
+    "compile_cache_dir",    # where XLA's own persistent cache lands
 )
 
 
@@ -269,6 +291,16 @@ class PertConfig:
     # jax_compilation_cache_dir (env var, test harness) wins.  See
     # utils.profiling.enable_persistent_compile_cache.
     compile_cache_dir: Optional[str] = "auto"
+    # persistent AOT EXECUTABLE cache (infer/aotcache.py): a directory
+    # of serialized compiled executables keyed by a cross-process-stable
+    # digest (program tag + abstract signature + optimiser statics +
+    # behavioural-config digest + jax/jaxlib version + backend/device
+    # kind + mesh topology — the FL004-certified contract).  A cold
+    # process deserializes instead of invoking XLA: zero-compile
+    # restarts for the serve worker and elastic/resume re-entries.
+    # None (default) disables; the serve worker defaults its store next
+    # to the spool.  Excluded from the config hash (NON_HASH_FIELDS).
+    executable_cache_dir: Optional[str] = None
     # structured run telemetry (obs/runlog.py): 'auto' (default) writes
     # one versioned-schema JSONL event log per run under the repo-local
     # `.pert_runs/` directory (per-user tmp fallback); a path targets a

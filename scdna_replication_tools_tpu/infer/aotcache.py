@@ -1,0 +1,368 @@
+"""Persistent on-disk AOT executable cache under the in-process
+program cache (infer/svi.py).
+
+The in-process ``_PROGRAM_CACHE`` dedupes trace+compile within ONE
+process; the persistent XLA compilation cache
+(utils.profiling.enable_persistent_compile_cache) only skips the
+backend-compile half and still pays tracing + lowering on every cold
+process.  This layer makes the COMPILED EXECUTABLE itself durable:
+on a cold in-process miss the resolver probes this store first and
+deserializes (``jax.experimental.serialize_executable``) instead of
+invoking XLA, so a freshly restarted serve worker (or a resumed /
+mesh-shrunk re-entry) serves its first same-bucket request with zero
+XLA compiles — the ``cache="disk_hit"`` arm of the ``compile``
+telemetry event, timed as ``deserialize_seconds``.
+
+Key contract (certified by the FL004 program-identity certificate —
+see tools/pertlint/flow): an entry's digest is a cross-process-stable
+SHA-256 over exactly the ``KEY_COMPONENTS`` below.  The config digest
+is the run-log ``_config_digest`` — the config hash restricted to the
+complement of ``config.NON_HASH_FIELDS`` — so no excluded field
+(telemetry paths, request ids, ...) can key an executable, and any
+behavioural field not otherwise visible in the program signature
+conservatively invalidates.  Environment facts (jax/jaxlib version,
+backend, device kind, mesh topology) are validated AGAIN at load
+time: a version or device-kind mismatch is a miss, never a
+deserialize.
+
+Robustness: writes go through ``utils.fileio.atomic_write_bytes``
+(no torn entries), a truncated/corrupt/undeserializable entry is
+quarantined (renamed ``*.bad``) and falls back to a clean recompile,
+and the store is LRU-by-mtime size-capped.  Every failure path
+degrades to "compile like before" — this layer may only ever make
+cold starts faster, never a fit wronger.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import re
+import threading
+import time
+from typing import Optional
+
+from scdna_replication_tools_tpu.utils.fileio import atomic_write_bytes
+from scdna_replication_tools_tpu.utils.profiling import logger
+
+SCHEMA = "pert-aot-exec/v1"
+
+# The canonical key components, in digest order.  This literal tuple is
+# read STATICALLY by the flow linter (tools/pertlint/flow/engine.py) and
+# cross-checked against the provenance map behind the ``aot_disk_key``
+# section of artifacts/PROGRAM_IDENTITY.json — adding a component here
+# without teaching the certificate its provenance gates CI via FL004.
+KEY_COMPONENTS = (
+    "program-tag",           # "fit" / "chunk" / "slab<W>" resolver tag
+    "loss-structure",        # value-repr of the hashable loss callable
+    "optimizer-statics",     # static_kwargs: lr/betas/budgets/dtypes
+    "abstract-signature",    # treedef + shape/dtype/weak_type/sharding
+    "config-digest",         # PertConfig hash over NON_HASH_FIELDS' complement
+    "jax-version",
+    "jaxlib-version",
+    "backend",               # jax.default_backend(): cpu/tpu/gpu
+    "device-kind",           # e.g. "TPU v4" — ISA-incompatible kinds miss
+    "mesh-topology",         # device/local-device/process counts
+)
+
+_ADDR = re.compile(r"0x[0-9a-fA-F]+")
+
+# files the store owns: <digest>.pertexec (live) / *.pertexec.bad
+# (quarantined for post-mortem, invisible to probes and eviction counts)
+_SUFFIX = ".pertexec"
+
+
+def canonical_key_text(key) -> str:
+    """Cross-process-canonical serialization of an in-process program
+    cache key: the repr with memory addresses scrubbed (reprs of
+    specs/treedefs/shardings are structural and deterministic; only
+    embedded ``0x...`` ids vary across processes)."""
+    return _ADDR.sub("0xADDR", repr(key))
+
+
+def environment_facts() -> dict:
+    """The executable-portability facts baked into every digest and
+    re-validated at load time."""
+    import jax
+    import jaxlib
+
+    devices = jax.devices()
+    return {
+        "jax_version": jax.__version__,
+        "jaxlib_version": jaxlib.__version__,
+        "backend": jax.default_backend(),
+        "device_kind": devices[0].device_kind if devices else "none",
+        "device_count": jax.device_count(),
+        "local_device_count": jax.local_device_count(),
+        "process_count": jax.process_count(),
+    }
+
+
+def key_digest(key_text: str, env: Optional[dict] = None,
+               config_digest: Optional[str] = None) -> str:
+    """The cross-process-stable store digest: SHA-256 over the
+    canonical key text + environment facts + behavioural config digest
+    (see KEY_COMPONENTS)."""
+    if env is None:
+        env = environment_facts()
+    if config_digest is None:
+        config_digest = _CONFIG_DIGEST
+    blob = json.dumps({"key": key_text, "env": env,
+                       "config": config_digest}, sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()[:32]
+
+
+def signature_shapes(key, cap: int = 12) -> list:
+    """Distinct leaf shapes of the key's abstract signature, for the
+    warm-up thread's bucket matching (a bucket's (cells, loci) padding
+    shows up as the trailing dims of the big per-locus arrays)."""
+    shapes = []
+    try:
+        for leaf_sig in key[3][1]:
+            shp = leaf_sig[0]
+            if isinstance(shp, tuple) and shp not in shapes:
+                shapes.append(shp)
+                if len(shapes) >= cap:
+                    break
+    except (IndexError, TypeError):
+        pass
+    return [list(s) for s in shapes]
+
+
+class ExecutableStore:
+    """One directory of serialized compiled executables.
+
+    All mutating paths are best-effort: a failed save/evict logs and
+    returns, a failed load quarantines and misses.  Thread-safe — the
+    batched serve worker probes from concurrent block threads while the
+    warm-up thread preloads.
+    """
+
+    def __init__(self, root: str, max_entries: int = 64):
+        self.root = os.path.abspath(root)
+        self.max_entries = max_entries
+        os.makedirs(self.root, exist_ok=True)
+        self._lock = threading.Lock()
+        # digest -> (compiled, stats, deserialize_seconds): entries the
+        # warm-up thread already deserialized+loaded, consumed (popped)
+        # by the first probe so the program cache takes ownership
+        self._preloaded: dict = {}
+
+    def path(self, digest: str) -> str:
+        return os.path.join(self.root, digest + _SUFFIX)
+
+    # -- write side --------------------------------------------------
+
+    def save(self, digest: str, key_text: str, compiled, stats: dict,
+             meta: Optional[dict] = None) -> tuple:
+        """Serialize ``compiled`` into the store (atomic; best-effort).
+
+        Returns ``(landed, reason)``: ``(True, "saved")`` when the
+        entry landed, else ``(False, ...)`` with the cause —
+        ``"unserializable"`` (the backend refused to serialize this
+        executable; the store simply never accelerates it) or
+        ``"unloadable"`` (the payload failed round-trip verification;
+        the caller may recompile with jax's compilation cache bypassed
+        and retry) or ``"error"`` (I/O)."""
+        try:
+            from jax.experimental.serialize_executable import (
+                deserialize_and_load,
+                serialize,
+            )
+
+            payload, in_tree, out_tree = serialize(compiled)
+        except Exception as exc:  # noqa: BLE001 — never fail the fit path
+            logger.debug("aotcache: save skipped for %s: %s", digest, exc)
+            return False, "unserializable"
+        try:
+            # Round-trip gate: an XLA:CPU executable that was itself
+            # revived from jax's persistent COMPILATION cache (the
+            # repo-local .jax_cache) serializes into a payload with
+            # dangling fusion symbols — deserialize raises
+            # ``INTERNAL: Symbols not found``.  Landing such an entry
+            # would poison every future cold start (quarantine +
+            # honest recompile, forever), so an entry must prove it
+            # loads back before it is written.
+            deserialize_and_load(payload, in_tree, out_tree)
+        except Exception as exc:  # noqa: BLE001
+            logger.debug("aotcache: save rejected for %s (payload does "
+                         "not load back): %s", digest, exc)
+            return False, "unloadable"
+        try:
+            record = {
+                "schema": SCHEMA,
+                "key": key_text,
+                "env": environment_facts(),
+                "meta": dict(meta or {}),
+                "stats": dict(stats or {}),
+                "payload": payload,
+                "in_tree": in_tree,
+                "out_tree": out_tree,
+            }
+            record["meta"].setdefault("created", time.time())
+            atomic_write_bytes(self.path(digest), pickle.dumps(record))
+            self._evict()
+            return True, "saved"
+        except Exception as exc:  # noqa: BLE001
+            logger.debug("aotcache: save skipped for %s: %s", digest, exc)
+            return False, "error"
+
+    def _evict(self) -> None:
+        """LRU by mtime: probes touch their entry, so mtime order is
+        recency-of-use order."""
+        try:
+            entries = [(os.path.getmtime(p), p) for p in self._paths()]
+            entries.sort()
+            while len(entries) > self.max_entries:
+                _, victim = entries.pop(0)
+                os.remove(victim)
+                logger.debug("aotcache: evicted %s",
+                             os.path.basename(victim))
+        except OSError as exc:
+            logger.debug("aotcache: eviction skipped: %s", exc)
+
+    def _paths(self) -> list:
+        return [os.path.join(self.root, n) for n in os.listdir(self.root)
+                if n.endswith(_SUFFIX)]
+
+    # -- read side ---------------------------------------------------
+
+    def load(self, digest: str):
+        """(compiled, stats, deserialize_seconds) or None.
+
+        Preloaded entries are served from RAM (deserialize already
+        paid by the warm-up thread).  Environment mismatch is a miss;
+        a corrupt or undeserializable entry is quarantined to
+        ``*.bad`` and misses."""
+        with self._lock:
+            pre = self._preloaded.pop(digest, None)
+        if pre is not None:
+            return pre
+        return self._load_from_disk(digest)
+
+    def _load_from_disk(self, digest: str):
+        path = self.path(digest)
+        if not os.path.exists(path):
+            return None
+        t0 = time.perf_counter()
+        try:
+            with open(path, "rb") as fh:
+                record = pickle.loads(fh.read())
+            if record.get("schema") != SCHEMA:
+                raise ValueError(f"schema {record.get('schema')!r}")
+        except Exception as exc:  # pertlint: disable=PL011 — _quarantine logs
+            self._quarantine(path, exc)
+            return None
+        if not self._env_ok(record.get("env", {})):
+            return None  # honest miss: wrong jax/device — not corrupt
+        try:
+            from jax.experimental.serialize_executable import (
+                deserialize_and_load,
+            )
+
+            compiled = deserialize_and_load(record["payload"],
+                                            record["in_tree"],
+                                            record["out_tree"])
+        except Exception as exc:  # pertlint: disable=PL011 — _quarantine logs
+            self._quarantine(path, exc)
+            return None
+        try:
+            os.utime(path)  # LRU touch
+        except OSError:
+            pass
+        return compiled, dict(record.get("stats") or {}), \
+            time.perf_counter() - t0
+
+    def _env_ok(self, env: dict) -> bool:
+        here = environment_facts()
+        for field in ("jax_version", "jaxlib_version", "backend",
+                      "device_kind", "device_count",
+                      "local_device_count", "process_count"):
+            if env.get(field) != here.get(field):
+                logger.debug("aotcache: env mismatch on %s: %r != %r",
+                             field, env.get(field), here.get(field))
+                return False
+        return True
+
+    def _quarantine(self, path: str, exc: Exception) -> None:
+        logger.warning("aotcache: quarantining corrupt entry %s (%s)",
+                       os.path.basename(path), exc)
+        try:
+            os.replace(path, path + ".bad")
+        except OSError:
+            pass
+
+    # -- warm-up side ------------------------------------------------
+
+    def entries(self) -> list:
+        """[{digest, meta, mtime}] for every live entry — metadata only
+        (the payload is unpickled but not deserialized to devices)."""
+        out = []
+        for path in self._paths():
+            digest = os.path.basename(path)[:-len(_SUFFIX)]
+            try:
+                with open(path, "rb") as fh:
+                    record = pickle.loads(fh.read())
+                out.append({"digest": digest,
+                            "meta": dict(record.get("meta") or {}),
+                            "mtime": os.path.getmtime(path)})
+            except Exception as exc:  # pertlint: disable=PL011 — logged
+                self._quarantine(path, exc)
+        return out
+
+    def preload(self, digest: str) -> bool:
+        """Deserialize+load an entry ahead of traffic (warm-up thread);
+        the first probe for its key consumes it without touching disk."""
+        with self._lock:
+            if digest in self._preloaded:
+                return True
+        loaded = self._load_from_disk(digest)
+        if loaded is None:
+            return False
+        with self._lock:
+            self._preloaded[digest] = loaded
+        return True
+
+    def preloaded_count(self) -> int:
+        with self._lock:
+            return len(self._preloaded)
+
+
+# -- the process-wide activation seam --------------------------------
+#
+# Mirrors the faults/metrics installs: the newest runner's config wins.
+# The store instance survives re-activation on the same directory, so a
+# serve worker's warm-up preloads are not dropped when the first
+# request's runner re-activates the same path.
+
+_ACTIVE: Optional[ExecutableStore] = None
+_CONFIG_DIGEST: Optional[str] = None
+_ACTIVATE_LOCK = threading.Lock()
+
+
+def activate(root: Optional[str],
+             config_digest: Optional[str] = None) -> Optional[ExecutableStore]:
+    """Install (or refresh) the process-wide store.  ``root`` of
+    None/"none" deactivates.  Returns the active store."""
+    global _ACTIVE, _CONFIG_DIGEST
+    with _ACTIVATE_LOCK:
+        if not root or str(root).lower() == "none":
+            _ACTIVE = None
+            _CONFIG_DIGEST = None
+            return None
+        root = os.path.abspath(str(root))
+        if _ACTIVE is None or _ACTIVE.root != root:
+            _ACTIVE = ExecutableStore(root)
+        _CONFIG_DIGEST = config_digest
+        return _ACTIVE
+
+
+def active_store() -> Optional[ExecutableStore]:
+    return _ACTIVE
+
+
+def deactivate() -> None:
+    """Test seam."""
+    activate(None)

@@ -239,6 +239,29 @@ class PertInference:
         # or disabled): repeated runs skip the per-step-program compiles
         self.compile_cache_dir = profiling.enable_persistent_compile_cache(
             config.compile_cache_dir)
+        # persistent AOT EXECUTABLE store (infer/aotcache.py): activated
+        # per runner construction, so resume and elastic mesh-shrink
+        # re-entries (each builds a fresh runner) probe the store and
+        # skip XLA entirely on a digest hit.  The digest embeds the
+        # PROGRAM-shaping config hash: NON_HASH_FIELDS' complement
+        # MINUS the execution-only path fields (AOT_EXECUTION_ONLY_
+        # FIELDS) — the serve worker moves checkpoint_dir per request,
+        # and a restarted worker must still disk-hit its predecessor's
+        # executables.  Newest runner wins, like the faults install
+        # below.
+        import dataclasses as _dc
+
+        from scdna_replication_tools_tpu.config import \
+            AOT_EXECUTION_ONLY_FIELDS
+        from scdna_replication_tools_tpu.infer import aotcache as \
+            aotcache_mod
+        from scdna_replication_tools_tpu.obs.runlog import _config_digest
+
+        aot_cfg = _dc.asdict(config)
+        for field in AOT_EXECUTION_ONLY_FIELDS:
+            aot_cfg.pop(field, None)
+        aotcache_mod.activate(config.executable_cache_dir,
+                              config_digest=_config_digest(aot_cfg))
         # fault-injection plan (utils/faults.py): config/env-gated,
         # deterministic, inert (a single global None check per site)
         # unless a spec is present.  Installed unconditionally — the

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from scdna_replication_tools_tpu.infer import aotcache as _aotcache
 from scdna_replication_tools_tpu.obs import doctor as _doctor
 from scdna_replication_tools_tpu.obs import runlog as _runlog
 from scdna_replication_tools_tpu.ops import adam_kernel as _adam_kernel
@@ -514,15 +515,23 @@ def make_opt_state(params: dict, learning_rate: float = 0.05,
 # also yields the trace/compile phase timings the orchestration layer
 # reports.  With the persistent compilation cache enabled (see
 # utils.profiling.enable_persistent_compile_cache), the compile() half is
-# served from disk across processes too.
+# served from disk across processes too; with the persistent EXECUTABLE
+# store activated (infer/aotcache.py, PertConfig.executable_cache_dir) a
+# cold process skips trace+lower+compile entirely and deserializes the
+# finished executable — the ``cache="disk_hit"`` telemetry arm.
 
 _PROGRAM_CACHE: "collections.OrderedDict" = collections.OrderedDict()
 _PROGRAM_CACHE_MAX = 32
-# dict ops only (get/move_to_end/insert/evict) — compilation itself runs
-# unlocked, so two threads cold-missing the same key may both compile
-# (last insert wins; same cost as two serial cold runs).  The batched
-# serving worker dispatches fits from concurrent block threads.
+# dict ops only (get/move_to_end/insert/evict); compilation runs
+# unlocked but DEDUPED: a cold miss registers a per-key in-flight event
+# in _PROGRAM_INFLIGHT under this lock, concurrent same-key misses wait
+# on it and re-read the cache instead of racing XLA (the batched
+# serving worker dispatches fits from concurrent block threads — the
+# old both-compile race wasted a full compile AND would write the disk
+# artifact twice).  A failed leader wakes followers with no cache
+# entry; each retries as leader itself.
 _PROGRAM_CACHE_LOCK = threading.Lock()
+_PROGRAM_INFLIGHT: dict = {}
 
 
 def _leaf_sig(leaf):
@@ -541,13 +550,15 @@ def clear_program_cache() -> None:
 
 
 def _key_hash(key) -> str:
-    """Stable-in-process content hash of a program-cache key, for the
-    telemetry ``compile`` events (reprs of specs/treedefs/shardings are
-    deterministic within a process — good enough to correlate events of
-    one run; NOT comparable across processes)."""
+    """Cross-process-comparable content hash of a program-cache key,
+    for the telemetry ``compile`` events: hashed over the SAME
+    canonical serialization the disk store digests (memory addresses
+    scrubbed), so compile events from different workers/hosts
+    correlate in pert_trace waterfalls."""
     import hashlib
 
-    return hashlib.sha256(repr(key).encode()).hexdigest()[:12]
+    text = _aotcache.canonical_key_text(key)
+    return hashlib.sha256(text.encode()).hexdigest()[:12]
 
 
 def _resolve_program(target, tag: str, loss_fn, dynamic_args,
@@ -564,10 +575,16 @@ def _resolve_program(target, tag: str, loss_fn, dynamic_args,
     lowered pytree.
 
     Every resolution emits a telemetry ``compile`` event to the active
-    RunLog (no-op outside a session): content hash, hit/miss,
-    trace/compile seconds, plus the program's cost_analysis FLOPs and
-    memory_analysis footprint (cached alongside the program so warm runs
-    still report their memory high-water)."""
+    RunLog (no-op outside a session): content hash, hit/miss/disk_hit,
+    trace/compile (or deserialize) seconds, plus the program's
+    cost_analysis FLOPs and memory_analysis footprint (cached alongside
+    the program so warm runs still report their memory high-water).
+
+    Cold misses are deduped per key: the first thread to miss becomes
+    the compile leader, concurrent same-key misses wait and then read
+    the cache (one XLA invocation, one disk artifact).  Before XLA the
+    leader probes the persistent executable store (infer/aotcache.py,
+    when activated) — a disk hit deserializes instead of compiling."""
     try:
         key = (tag, loss_fn, tuple(sorted(static_kwargs.items())),
                _abstract_sig((dynamic_args, dynamic_kwargs)))
@@ -577,50 +594,159 @@ def _resolve_program(target, tag: str, loss_fn, dynamic_args,
                                label=type(loss_fn).__name__, tag=tag,
                                cache="uncacheable")
         return None  # unhashable loss callable/sharding: fall back
-    with _PROGRAM_CACHE_LOCK:
-        cached = _PROGRAM_CACHE.get(key)
+    while True:
+        with _PROGRAM_CACHE_LOCK:
+            cached = _PROGRAM_CACHE.get(key)
+            if cached is not None:
+                _PROGRAM_CACHE.move_to_end(key)
+                inflight, leader = None, False
+            else:
+                inflight = _PROGRAM_INFLIGHT.get(key)
+                leader = inflight is None
+                if leader:
+                    inflight = threading.Event()
+                    _PROGRAM_INFLIGHT[key] = inflight
         if cached is not None:
-            _PROGRAM_CACHE.move_to_end(key)
-    if cached is not None:
-        timings["program_cache"] = "hit"
-        compiled, stats = cached
+            timings["program_cache"] = "hit"
+            compiled, stats = cached
+            _runlog.current().emit("compile", key_hash=_key_hash(key),
+                                   label=type(loss_fn).__name__, tag=tag,
+                                   cache="hit",
+                                   trace_seconds=0.0, compile_seconds=0.0,
+                                   **stats)
+            return compiled
+        if leader:
+            break
+        # follower: the leader is compiling this exact key — wait, then
+        # re-read the cache (a dead leader leaves no entry; retry as
+        # leader ourselves)
+        inflight.wait()
+    try:
+        store = _aotcache.active_store()
+        ktext = digest = None
+        if store is not None:
+            ktext = _aotcache.canonical_key_text(key)
+            digest = _aotcache.key_digest(ktext)
+            loaded = store.load(digest)
+            if loaded is not None:
+                compiled, stats, deser = loaded
+                timings["program_cache"] = "disk_hit"
+                timings["deserialize"] = deser
+                _runlog.current().emit(
+                    "compile", key_hash=_key_hash(key),
+                    label=type(loss_fn).__name__, tag=tag,
+                    cache="disk_hit",
+                    deserialize_seconds=round(deser, 4),
+                    trace_seconds=0.0, compile_seconds=0.0, **stats)
+                with _PROGRAM_CACHE_LOCK:
+                    _PROGRAM_CACHE[key] = (compiled, stats)
+                    while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_MAX:
+                        _PROGRAM_CACHE.popitem(last=False)
+                return compiled
+        t0 = time.perf_counter()
+        lowered = target.lower(loss_fn, *dynamic_args, **dynamic_kwargs,
+                               **static_kwargs)
+        t1 = time.perf_counter()
+
+        # per-phase watchdog: an XLA compile over a dead TPU tunnel
+        # blocks forever with ~0 CPU (the BENCH_r05 rc=124 failure
+        # mode); the deadline converts that into a typed,
+        # checkpointable abort.  The fault-injection site sits INSIDE
+        # the deadline so a simulated `hang@compile` exercises the real
+        # watchdog path.
+        def _do_compile():
+            _faults.point("compile")
+            return lowered.compile()
+
+        compiled = _faults.run_with_deadline(
+            _do_compile, compile_deadline, f"compile:{tag}")
+        t2 = time.perf_counter()
+        timings["trace"] = t1 - t0
+        timings["compile"] = t2 - t1
+        timings["program_cache"] = "miss"
+        stats = _runlog.compiled_program_stats(compiled)
+        extra = {"aot_disk": "miss"} if store is not None else {}
         _runlog.current().emit("compile", key_hash=_key_hash(key),
                                label=type(loss_fn).__name__, tag=tag,
-                               cache="hit",
-                               trace_seconds=0.0, compile_seconds=0.0,
-                               **stats)
+                               cache="miss",
+                               trace_seconds=round(t1 - t0, 4),
+                               compile_seconds=round(t2 - t1, 4),
+                               **extra, **stats)
+        with _PROGRAM_CACHE_LOCK:
+            _PROGRAM_CACHE[key] = (compiled, stats)
+            while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_MAX:
+                _PROGRAM_CACHE.popitem(last=False)
+        if store is not None:
+            meta = {"tag": tag,
+                    "label": type(loss_fn).__name__,
+                    "key_hash": _key_hash(key),
+                    "shapes": _aotcache.signature_shapes(key)}
+            landed, why = store.save(digest, ktext, compiled, stats,
+                                     meta=meta)
+            if not landed and why == "unloadable":
+                # The executable XLA revived from its persistent
+                # COMPILATION cache does not survive serialize/
+                # deserialize (dangling fusion symbols on XLA:CPU) —
+                # recompile once to get a payload that round-trips,
+                # and keep serving from the original.  Two layers of
+                # reuse must be sidestepped: jax memoizes its
+                # cache-enabled decision per process (is_cache_used),
+                # so the ``enable_compilation_cache`` config toggle is
+                # inert after the first compile — the memo itself is
+                # flipped (under jax's own mutex) so the retry goes
+                # straight to backend_compile, no cache read OR write;
+                # and a bare re-``compile()`` would return the SAME
+                # revived executable from jax's in-memory layer, so
+                # the retry passes an explicitly-default compiler
+                # option (a codegen no-op that changes the in-memory
+                # key).  Best-effort: any failure — including these
+                # private attrs moving in a future jax — just leaves
+                # this program un-stored.
+                try:
+                    from jax._src import compilation_cache as _jcc
+                    with _jcc._cache_initialized_mutex:
+                        prev = (_jcc._cache_checked, _jcc._cache_used)
+                        _jcc._cache_checked, _jcc._cache_used = True, False
+                    try:
+                        fresh = lowered.compile(compiler_options={
+                            "xla_embed_ir_in_executable": False})
+                    finally:
+                        with _jcc._cache_initialized_mutex:
+                            _jcc._cache_checked, _jcc._cache_used = prev
+                    store.save(digest, ktext, fresh, stats, meta=meta)
+                except Exception as exc:  # noqa: BLE001
+                    _aotcache.logger.debug(
+                        "aotcache: cache-bypassed recompile for %s "
+                        "failed (program stays un-stored): %s",
+                        digest, exc)
         return compiled
-    t0 = time.perf_counter()
-    lowered = target.lower(loss_fn, *dynamic_args, **dynamic_kwargs,
-                           **static_kwargs)
-    t1 = time.perf_counter()
+    finally:
+        with _PROGRAM_CACHE_LOCK:
+            _PROGRAM_INFLIGHT.pop(key, None)
+        inflight.set()
 
-    # per-phase watchdog: an XLA compile over a dead TPU tunnel blocks
-    # forever with ~0 CPU (the BENCH_r05 rc=124 failure mode); the
-    # deadline converts that into a typed, checkpointable abort.  The
-    # fault-injection site sits INSIDE the deadline so a simulated
-    # `hang@compile` exercises the real watchdog path.
-    def _do_compile():
-        _faults.point("compile")
-        return lowered.compile()
 
-    compiled = _faults.run_with_deadline(
-        _do_compile, compile_deadline, f"compile:{tag}")
-    t2 = time.perf_counter()
-    timings["trace"] = t1 - t0
-    timings["compile"] = t2 - t1
-    timings["program_cache"] = "miss"
-    stats = _runlog.compiled_program_stats(compiled)
-    _runlog.current().emit("compile", key_hash=_key_hash(key),
-                           label=type(loss_fn).__name__, tag=tag,
-                           cache="miss",
-                           trace_seconds=round(t1 - t0, 4),
-                           compile_seconds=round(t2 - t1, 4), **stats)
-    with _PROGRAM_CACHE_LOCK:
-        _PROGRAM_CACHE[key] = (compiled, stats)
-        while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_MAX:
-            _PROGRAM_CACHE.popitem(last=False)
-    return compiled
+def resolve_jit_program(target, tag: str, head, dynamic_args,
+                        static_kwargs: Optional[dict] = None):
+    """AOT-resolve an auxiliary jitted entry point (decode/PPC slabs)
+    through the same machinery as the fit programs: in-process LRU,
+    in-flight compile dedup, the persistent executable store, and the
+    telemetry ``compile`` event stream.
+
+    ``head`` is the entry point's leading (static) argument — the model
+    spec for the slab programs, playing the role ``loss_fn`` plays for
+    the fit programs: part of the cache key, first operand of
+    ``target.lower``.  Returns the compiled program — invoke it as
+    ``compiled(*dynamic_args)`` (static args are bound at lowering
+    time) — or None when the key is unhashable; callers fall back to
+    the plain jit call, which behaves identically minus the store.
+
+    Without this, only the fit programs survived a process restart:
+    the restarted serve worker's first request paid ZERO fit compiles
+    but still multi-second traces for decode/PPC — the long pole of
+    the cold-start A/B (``bench.py --serve-ab --restart``)."""
+    return _resolve_program(target, tag, head, tuple(dynamic_args),
+                            {}, dict(static_kwargs or {}), {})
 
 
 def fit_map(loss_fn: Callable, params0: dict, loss_args: tuple = (),

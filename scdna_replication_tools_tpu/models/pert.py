@@ -1080,6 +1080,21 @@ def entropy_from_joint(joint: jnp.ndarray):
     return (jnp.clip(cn_ent, 0.0, 1.0), jnp.clip(rep_ent, 0.0, 1.0))
 
 
+def _resolve_slab_program(target, tag, spec, dynamic_args,
+                          static_kwargs):
+    """Resolve a slab entry point through the shared program machinery
+    (infer.svi.resolve_jit_program): in-process LRU, in-flight compile
+    dedup, and the persistent executable store — so a fresh process
+    deserializes yesterday's decode/PPC executables instead of paying
+    their trace+compile again.  Lazy import: models/ stays importable
+    without the infer layer.  None (unhashable key) → the caller falls
+    back to the plain jit call."""
+    from scdna_replication_tools_tpu.infer.svi import resolve_jit_program
+
+    return resolve_jit_program(target, tag, spec, dynamic_args,
+                               static_kwargs=static_kwargs)
+
+
 @functools.partial(jax.jit, static_argnames=("spec", "want_entropy"))
 def _decode_slab(spec: PertModelSpec, params: dict, fixed: dict,
                  batch: PertBatch, want_entropy: bool = False):
@@ -1138,8 +1153,12 @@ def decode_discrete(spec: PertModelSpec, params: dict, fixed: dict,
     for idx in _decode_slabs(spec, batch, cell_chunk):
         p, b = (params, batch) if idx is None \
             else slice_cells(params, batch, idx)
-        outs.append(_decode_slab(spec, p, fixed, b,
-                                 want_entropy=want_entropy))
+        compiled = _resolve_slab_program(
+            _decode_slab, "decode_slab", spec, (p, fixed, b),
+            {"want_entropy": want_entropy})
+        outs.append(compiled(p, fixed, b) if compiled is not None
+                    else _decode_slab(spec, p, fixed, b,
+                                      want_entropy=want_entropy))
     if len(outs) == 1:
         return outs[0]
     # the tail slab clamps its indices to the last cell: trim duplicates
@@ -1335,9 +1354,14 @@ def ppc_discrepancy(spec: PertModelSpec, params: dict, fixed: dict,
             else slice_cells(params, batch, idx)
         cm, rm = (cn_map, rep_map) if idx is None \
             else (cn_map[idx], rep_map[idx])
-        outs.append(_ppc_slab(spec, p, fixed, b, cm, rm,
-                              jax.random.fold_in(key, si),
-                              num_replicates=int(num_replicates)))
+        slab_key = jax.random.fold_in(key, si)
+        compiled = _resolve_slab_program(
+            _ppc_slab, "ppc", spec, (p, fixed, b, cm, rm, slab_key),
+            {"num_replicates": int(num_replicates)})
+        outs.append(compiled(p, fixed, b, cm, rm, slab_key)
+                    if compiled is not None
+                    else _ppc_slab(spec, p, fixed, b, cm, rm, slab_key,
+                                   num_replicates=int(num_replicates)))
     if len(outs) == 1:
         return outs[0]
     return tuple(jnp.concatenate([o[i] for o in outs], axis=0)[:num_cells]

@@ -336,8 +336,18 @@ class MetricsRegistry:
             cache = payload.get("cache")
             if cache == "hit":
                 self.counter("pert_compile_cache_hits_total").inc()
+            elif cache == "disk_hit":
+                # persistent AOT executable store (infer/aotcache.py):
+                # the program was deserialized, not compiled
+                self.counter("pert_aot_disk_hits_total").inc()
+                if payload.get("deserialize_seconds") is not None:
+                    self.observe("pert_aot_deserialize_seconds",
+                                 payload["deserialize_seconds"])
             elif cache == "miss":
                 self.counter("pert_compile_cache_misses_total").inc()
+                if payload.get("aot_disk") == "miss":
+                    # the store was active and probed before XLA ran
+                    self.counter("pert_aot_disk_misses_total").inc()
                 if payload.get("trace_seconds") is not None:
                     self.observe("pert_trace_seconds",
                                  payload["trace_seconds"])

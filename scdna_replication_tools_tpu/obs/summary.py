@@ -99,6 +99,8 @@ def derived_metrics(summary: dict) -> dict:
         out["pert_compile_cache_hits_total"] = comp.get("cache_hits", 0)
         out["pert_compile_cache_misses_total"] = comp.get("cache_misses",
                                                           0)
+        if comp.get("disk_hits"):
+            out["pert_aot_disk_hits_total"] = comp["disk_hits"]
     if comp.get("peak_bytes_max") is not None:
         out["pert_peak_hbm_bytes"] = comp["peak_bytes_max"]
     return out
@@ -136,6 +138,7 @@ def summarize_events(events: List[dict]) -> dict:
     compiles = _of(events, "compile")
     cache_hits = sum(1 for ev in compiles if ev.get("cache") == "hit")
     cache_misses = sum(1 for ev in compiles if ev.get("cache") == "miss")
+    disk_hits = sum(1 for ev in compiles if ev.get("cache") == "disk_hit")
     peak_bytes = [ev["peak_bytes"] for ev in compiles
                   if isinstance(ev.get("peak_bytes"), (int, float))]
 
@@ -240,15 +243,25 @@ def summarize_events(events: List[dict]) -> dict:
             "programs": len(compiles),
             "cache_hits": cache_hits,
             "cache_misses": cache_misses,
+            # persistent AOT executable store (infer/aotcache.py):
+            # programs deserialized from disk instead of compiled —
+            # like a hit, they paid no XLA invocation
+            "disk_hits": disk_hits,
             # over cacheable resolutions only: 'uncacheable' events
             # (unhashable loss closures) are neither hits nor misses and
-            # would understate the rate
-            "hit_rate": (round(cache_hits / (cache_hits + cache_misses), 4)
-                         if cache_hits + cache_misses else None),
+            # would understate the rate; disk hits count as hits (no
+            # XLA ran)
+            "hit_rate": (round(
+                (cache_hits + disk_hits)
+                / (cache_hits + disk_hits + cache_misses), 4)
+                if cache_hits + disk_hits + cache_misses else None),
             "trace_seconds": round(sum(
                 float(ev.get("trace_seconds", 0.0)) for ev in compiles), 4),
             "compile_seconds": round(sum(
                 float(ev.get("compile_seconds", 0.0))
+                for ev in compiles), 4),
+            "deserialize_seconds": round(sum(
+                float(ev.get("deserialize_seconds", 0.0))
                 for ev in compiles), 4),
             "peak_bytes_max": max(peak_bytes) if peak_bytes else None,
         },

@@ -114,6 +114,19 @@ def main(argv=None) -> int:
                                "the resident compiled programs; "
                                "converged blocks retire and refill "
                                "from the spool at once")
+    p_worker.add_argument("--executable-cache", default="auto",
+                          help="persistent AOT executable store "
+                               "(infer/aotcache.py): 'auto' (default) "
+                               "keeps it next to the spool "
+                               "(<spool>/exec_cache) so a restarted or "
+                               "sibling worker serves its first "
+                               "same-bucket request with ZERO XLA "
+                               "compiles (cache=\"disk_hit\"); a path "
+                               "pins it; 'none' disables.  A warm-up "
+                               "thread pre-loads the popular "
+                               "bucket-ladder rungs from the previous "
+                               "worker's buckets_served ledger before "
+                               "traffic arrives")
     p_worker.add_argument("--trace-spans", default=True,
                           action=argparse.BooleanOptionalAction,
                           help="causal span tracing per request "
@@ -182,7 +195,8 @@ def main(argv=None) -> int:
             exit_when_idle=args.exit_when_idle,
             default_options=_parse_option(args.option),
             trace_spans=args.trace_spans,
-            max_batch=args.max_batch)
+            max_batch=args.max_batch,
+            executable_cache_dir=args.executable_cache)
         stats = worker.run()
         _emit(json.dumps(stats, indent=1))
         return 0

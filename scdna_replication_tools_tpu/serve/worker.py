@@ -77,6 +77,7 @@ import functools
 import itertools
 import json
 import os
+import re
 import signal
 import threading
 import time
@@ -128,6 +129,11 @@ _WORKER_LOG_COUNTER = itertools.count()
 # max_requests anyway), bounded so the production daemon's RSS is flat
 RECENT_OUTCOMES = 256
 
+# most store entries the warm-up thread deserializes ahead of traffic:
+# a full serve program set is ~4 programs per bucket rung, so 16 covers
+# the top few rungs without pinning a whole 64-entry store in RAM
+WARMUP_PRELOAD_MAX = 16
+
 
 @dataclasses.dataclass
 class RequestOutcome:
@@ -157,7 +163,8 @@ class ServeWorker:
                  exit_when_idle: bool = False,
                  default_options: Optional[dict] = None,
                  trace_spans: bool = True,
-                 max_batch: int = 1):
+                 max_batch: int = 1,
+                 executable_cache_dir: Optional[str] = "auto"):
         self.queue = queue
         self.buckets = buckets or BucketSet()
         self.poll_interval = float(poll_interval)
@@ -230,6 +237,24 @@ class ServeWorker:
         self._bucket_ledger: dict = {}
         self._heartbeat_stop = threading.Event()
         queue.ensure_dirs()
+        # persistent AOT executable store (infer/aotcache.py): 'auto'
+        # (default) keeps it NEXT TO THE SPOOL so a restarted / sibling
+        # worker inherits every compiled program the fleet has paid
+        # for; a path pins it; None/'none' disables.  The warm-up
+        # thread (started in run()) pre-loads the popular bucket-ladder
+        # rungs recorded by the PREVIOUS worker's buckets_served ledger
+        # — snapshot that ledger NOW, before our own heartbeat rewrites
+        # status.json
+        if executable_cache_dir == "auto":
+            executable_cache_dir = str(queue.root / "exec_cache")
+        elif (executable_cache_dir is None
+              or str(executable_cache_dir).lower() == "none"):
+            executable_cache_dir = None
+        self.executable_cache_dir = executable_cache_dir
+        self._prior_buckets = self._read_prior_bucket_ledger()
+        self._warmup_info: dict = {"dir": executable_cache_dir,
+                                   "preloaded": 0, "entries": 0,
+                                   "done": executable_cache_dir is None}
         if telemetry_path is None:
             # pid + counter in the default name: multiple workers may
             # share one spool (the queue's rename-based claiming
@@ -294,12 +319,23 @@ class ServeWorker:
             "default_options": self.default_options,
             "trace_spans": self.trace_spans,
             "max_batch": self.max_batch,
+            "executable_cache": self.executable_cache_dir,
         }
         heartbeat = threading.Thread(target=self._heartbeat_loop,
                                      name="pert-serve-status",
                                      daemon=True)
         self._heartbeat_stop.clear()
         heartbeat.start()
+        if self.executable_cache_dir is not None:
+            # background pre-warm: deserialize the popular rungs of the
+            # bucket ladder (per the previous worker's buckets_served
+            # residency ledger, slab<W> rungs included) before traffic
+            # arrives — a one-shot daemon thread, racing the first
+            # request harmlessly (the store's preload map is consumed
+            # under its own lock; an unpreloaded probe just reads disk)
+            threading.Thread(target=self._warmup_executables,
+                             name="pert-serve-aot-warmup",
+                             daemon=True).start()
         try:
             with self.worker_log.session(config=config,
                                          run_name="pert_serve"):
@@ -467,6 +503,73 @@ class ServeWorker:
         while not self._heartbeat_stop.wait(interval):
             self._write_status()
 
+    # -- executable-cache pre-warm ----------------------------------------
+
+    def _read_prior_bucket_ledger(self) -> dict:
+        """The PREVIOUS worker's buckets_served ledger out of
+        status.json — the residency signal that drives which rungs the
+        warm-up thread deserializes first.  Read at construction, before
+        this worker's own heartbeat rewrites the file."""
+        try:
+            with open(self.queue.status_path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+            if doc.get("kind") == "pert_serve_status":
+                return dict(doc.get("buckets_served") or {})
+        except (OSError, ValueError):
+            pass
+        return {}
+
+    def _warmup_executables(self) -> None:
+        """One-shot background pre-warm: rank the store's entries by
+        the prior ledger's per-bucket traffic (an entry belongs to a
+        bucket when its recorded signature shapes end in that bucket's
+        (cells, loci) padding — slab entries carry (W, cells, loci), so
+        the PR-17 slab<W> rungs rank right alongside) and pre-load the
+        winners so the first requests disk-hit from RAM."""
+        from scdna_replication_tools_tpu.infer import aotcache
+
+        try:
+            store = aotcache.activate(self.executable_cache_dir)
+            entries = store.entries()
+            ledger = self._prior_buckets
+
+            def _traffic(entry) -> int:
+                shapes = entry["meta"].get("shapes") or []
+                tails = {tuple(s[-2:]) for s in shapes if len(s) >= 2}
+                count = 0
+                for name, served in ledger.items():
+                    m = re.match(r"c(\d+)xl(\d+)$", name)
+                    if m and (int(m.group(1)), int(m.group(2))) in tails:
+                        count += int(served)
+                return count
+
+            ranked = sorted(entries,
+                            key=lambda e: (_traffic(e), e["mtime"]),
+                            reverse=True)
+            if ledger:
+                # ledger present: only rungs that actually saw traffic
+                ranked = [e for e in ranked if _traffic(e) > 0]
+            preloaded = 0
+            for entry in ranked[:WARMUP_PRELOAD_MAX]:
+                if self._heartbeat_stop.is_set() or self._draining:
+                    break
+                if store.preload(entry["digest"]):
+                    preloaded += 1
+            with self._state_lock:
+                self._warmup_info.update(
+                    preloaded=preloaded, entries=len(entries), done=True)
+            if preloaded:
+                logger.info(
+                    "pert-serve: executable warm-up pre-loaded %d/%d "
+                    "store entries from %s", preloaded, len(entries),
+                    self.executable_cache_dir)
+        except Exception as exc:  # noqa: BLE001 — warm-up is an
+            # optimisation; a failure must not take down the worker
+            logger.warning("pert-serve: executable warm-up failed: %s",
+                           exc)
+            with self._state_lock:
+                self._warmup_info.update(done=True, error=str(exc)[:200])
+
     def _inflight_doc(self, info: dict) -> dict:
         doc = dict(info)
         doc["age_seconds"] = round(
@@ -527,6 +630,9 @@ class ServeWorker:
             # this worker is keeping warm, and how much traffic each
             # has served — the eviction/right-sizing signal
             "buckets_served": dict(self._bucket_ledger),
+            # AOT executable store + warm-up progress: how many disk
+            # entries exist and how many the warm-up thread pre-loaded
+            "executable_cache": dict(self._warmup_info),
             "recent": [dataclasses.asdict(o)
                        for o in list(self.outcomes)[-10:]],
             "worker_log": self.worker_log.path,
@@ -819,7 +925,7 @@ class ServeWorker:
         compile_cache = {
             k: (summary.get("compile") or {}).get(k)
             for k in ("programs", "cache_hits", "cache_misses",
-                      "hit_rate")
+                      "disk_hits", "hit_rate")
         }
         slab_attrs = self._slab_end_attrs(rid)
         self.worker_log.emit(
@@ -830,8 +936,9 @@ class ServeWorker:
         self.queue.finish(ticket, "ok", results_dir=results_dir)
         logger.info(
             "pert-serve: request %s ok in %.1fs (bucket %s, compile "
-            "%s hit / %s miss)", rid, wall, bucket.name,
+            "%s hit / %s disk / %s miss)", rid, wall, bucket.name,
             compile_cache.get("cache_hits"),
+            compile_cache.get("disk_hits"),
             compile_cache.get("cache_misses"))
         return self._record(rid, "ok", wall, bucket=bucket_info,
                             run_log=run_log_path,
@@ -861,6 +968,7 @@ class ServeWorker:
             pad_loci_to=bucket.loci,
             request_id=rid,
             slab_width=(self.max_batch if self.max_batch > 1 else None),
+            executable_cache_dir=self.executable_cache_dir,
             **trace_kwargs,
             **options,
         )

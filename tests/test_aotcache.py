@@ -1,0 +1,334 @@
+"""Persistent AOT executable store (infer/aotcache.py).
+
+Four satellites of the zero-compile-cold-start contract:
+
+* robustness — a truncated/corrupt entry is quarantined (``*.bad``)
+  and the resolver falls back to a clean recompile; a jax-version or
+  device-kind mismatch is an honest miss, never a deserialize;
+* LRU — the on-disk store is size-capped, evicting
+  least-recently-USED (probes touch mtime);
+* cross-process — worker B disk-hits worker A's entry (the actual
+  fleet-restart story), with the canonical ``_key_hash`` comparable
+  across the two processes;
+* the double-compile race fix — concurrent same-signature cold misses
+  compile ONCE (per-key in-flight leader/followers), and a crashed
+  leader's followers retry instead of hanging.
+"""
+
+import glob
+import os
+import pickle
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scdna_replication_tools_tpu.infer import aotcache, svi
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _quad_loss(params, x):
+    return jnp.sum((params["w"] - x) ** 2)
+
+
+@pytest.fixture(autouse=True)
+def _isolated_caches():
+    svi.clear_program_cache()
+    aotcache.deactivate()
+    yield
+    svi.clear_program_cache()
+    aotcache.deactivate()
+
+
+def _fit(store_dir, n=4):
+    aotcache.activate(str(store_dir), config_digest="test-digest")
+    return svi.fit_map(_quad_loss, {"w": jnp.zeros(n)}, (jnp.ones(n),),
+                       max_iter=40, min_iter=10)
+
+
+def _store_files(store_dir):
+    return sorted(glob.glob(os.path.join(str(store_dir), "*.pertexec")))
+
+
+# -- roundtrip + robustness ------------------------------------------------
+
+def test_cold_process_miss_becomes_disk_hit(tmp_path):
+    r1 = _fit(tmp_path)
+    assert r1.timings["program_cache"] == "miss"
+    assert len(_store_files(tmp_path)) == 1
+    # a fresh process is simulated by clearing the in-process cache:
+    # the next resolution probes the disk store instead of XLA
+    svi.clear_program_cache()
+    r2 = _fit(tmp_path)
+    assert r2.timings["program_cache"] == "disk_hit"
+    assert r2.timings["deserialize"] > 0.0
+    np.testing.assert_allclose(np.asarray(r2.params["w"]),
+                               np.asarray(r1.params["w"]))
+
+
+def test_corrupt_entry_quarantined_then_clean_recompile(tmp_path):
+    _fit(tmp_path)
+    path = _store_files(tmp_path)[0]
+    with open(path, "wb") as fh:
+        fh.write(b"torn write, not a pickle")
+    svi.clear_program_cache()
+    r = _fit(tmp_path)
+    assert r.timings["program_cache"] == "miss"   # recompiled cleanly
+    bad = glob.glob(os.path.join(str(tmp_path), "*.bad"))
+    assert len(bad) == 1                          # quarantined, kept
+    # the recompile re-saved a healthy entry under the same digest
+    assert len(_store_files(tmp_path)) == 1
+
+
+def test_truncated_entry_quarantined(tmp_path):
+    _fit(tmp_path)
+    path = _store_files(tmp_path)[0]
+    data = open(path, "rb").read()
+    with open(path, "wb") as fh:
+        fh.write(data[: len(data) // 2])          # torn mid-payload
+    svi.clear_program_cache()
+    r = _fit(tmp_path)
+    assert r.timings["program_cache"] == "miss"
+    assert glob.glob(os.path.join(str(tmp_path), "*.bad"))
+
+
+def test_save_rejects_payload_that_does_not_load_back(tmp_path,
+                                                      monkeypatch):
+    # An XLA:CPU executable revived from jax's persistent COMPILATION
+    # cache serializes into a payload with dangling fusion symbols
+    # (deserialize raises "Symbols not found").  The save-side
+    # round-trip gate must refuse to land such an entry.
+    import jax.experimental.serialize_executable as se
+
+    real = se.serialize
+
+    def corrupting(compiled):
+        payload, in_tree, out_tree = real(compiled)
+        return payload[: len(payload) // 2], in_tree, out_tree
+
+    monkeypatch.setattr(se, "serialize", corrupting)
+    store = aotcache.ExecutableStore(str(tmp_path))
+    compiled = jax.jit(lambda x: x * 2).lower(jnp.zeros(3)).compile()
+    landed, why = store.save("dead", "key", compiled, {})
+    assert (landed, why) == (False, "unloadable")
+    assert not _store_files(tmp_path)             # nothing written
+    assert not glob.glob(os.path.join(str(tmp_path), "*.bad"))
+
+
+def test_unloadable_save_retries_with_compile_cache_bypassed(
+        tmp_path, monkeypatch):
+    # The resolver's reaction to an "unloadable" save: recompile once
+    # with jax's compilation cache bypassed and store THAT payload —
+    # the second serialize (of the fresh executable) round-trips.
+    import jax.experimental.serialize_executable as se
+
+    real = se.serialize
+    calls = {"n": 0}
+
+    def first_call_corrupts(compiled):
+        calls["n"] += 1
+        payload, in_tree, out_tree = real(compiled)
+        if calls["n"] == 1:
+            return payload[: len(payload) // 2], in_tree, out_tree
+        return payload, in_tree, out_tree
+
+    monkeypatch.setattr(se, "serialize", first_call_corrupts)
+    r = _fit(tmp_path)
+    assert r.timings["program_cache"] == "miss"
+    assert calls["n"] == 2                        # save, then retry
+    assert len(_store_files(tmp_path)) == 1       # retry landed it
+    svi.clear_program_cache()
+    assert _fit(tmp_path).timings["program_cache"] == "disk_hit"
+
+
+@pytest.mark.parametrize("field,value", [
+    ("jax_version", "0.0.0-elsewhere"),
+    ("device_kind", "TPU v9000"),
+    ("backend", "warp-drive"),
+])
+def test_env_mismatch_misses_without_deserializing(tmp_path, field, value):
+    _fit(tmp_path)
+    path = _store_files(tmp_path)[0]
+    record = pickle.loads(open(path, "rb").read())
+    record["env"][field] = value
+    with open(path, "wb") as fh:
+        fh.write(pickle.dumps(record))
+    store = aotcache.active_store()
+    digest = os.path.basename(path)[: -len(".pertexec")]
+    assert store._load_from_disk(digest) is None
+    # an env mismatch is an honest miss, NOT corruption: no quarantine
+    assert not glob.glob(os.path.join(str(tmp_path), "*.bad"))
+    assert os.path.exists(path)
+
+
+# -- LRU / size cap --------------------------------------------------------
+
+def _toy_compiled():
+    return jax.jit(lambda x: x + 1).lower(jnp.zeros(3)).compile()
+
+
+def test_store_evicts_least_recently_used(tmp_path):
+    store = aotcache.ExecutableStore(str(tmp_path), max_entries=10)
+    compiled = _toy_compiled()
+    now = time.time()
+    for i in range(4):
+        assert store.save(f"d{i:02d}", "key", compiled, {})[0]
+        # deterministic recency order regardless of fs mtime resolution
+        os.utime(store.path(f"d{i:02d}"), (now + i, now + i))
+    store.max_entries = 3
+    store._evict()
+    left = {os.path.basename(p) for p in _store_files(tmp_path)}
+    assert left == {"d01.pertexec", "d02.pertexec", "d03.pertexec"}
+    # a probe TOUCHES its entry: d01 becomes most-recent and survives
+    # the next insertion round, d02 (now oldest) is evicted
+    assert store.load("d01") is not None
+    os.utime(store.path("d01"), (now + 9, now + 9))
+    store.max_entries = 10          # keep save's own evict pass inert
+    store.save("d04", "key", compiled, {})
+    os.utime(store.path("d04"), (now + 10, now + 10))
+    store.max_entries = 3
+    store._evict()
+    left = {os.path.basename(p) for p in _store_files(tmp_path)}
+    assert left == {"d01.pertexec", "d03.pertexec", "d04.pertexec"}
+
+
+def test_preload_serves_from_ram(tmp_path):
+    _fit(tmp_path)
+    store = aotcache.active_store()
+    digest = os.path.basename(_store_files(tmp_path)[0])[
+        : -len(".pertexec")]
+    assert store.preload(digest)
+    assert store.preloaded_count() == 1
+    os.remove(store.path(digest))                 # disk gone, RAM serves
+    assert store.load(digest) is not None
+    assert store.preloaded_count() == 0           # consumed once
+
+
+# -- key canonicalisation --------------------------------------------------
+
+def test_canonical_key_text_scrubs_addresses():
+    key = ("fit", object(), (), ())
+    text = aotcache.canonical_key_text(key)
+    assert "0xADDR" in text
+    import re
+    assert not re.search(r"0x[0-9a-fA-F]{6,}", text)
+
+
+def test_key_digest_is_deterministic():
+    env = {"jax_version": "1", "backend": "cpu"}
+    a = aotcache.key_digest("ktext", env=env, config_digest="cfg")
+    b = aotcache.key_digest("ktext", env=env, config_digest="cfg")
+    assert a == b
+    assert a != aotcache.key_digest("ktext", env=env, config_digest="other")
+
+
+# -- two-process: worker B hits worker A's entry ---------------------------
+
+_CHILD = """
+import sys, json
+sys.path.insert(0, {root!r})
+import jax.numpy as jnp
+from scdna_replication_tools_tpu.infer import aotcache, svi
+from scdna_replication_tools_tpu.infer.svi import _key_hash, _abstract_sig
+
+def loss(params, x):
+    return jnp.sum((params["w"] - x) ** 2)
+
+aotcache.activate({store!r}, config_digest="shared")
+r = svi.fit_map(loss, {{"w": jnp.zeros(4)}}, (jnp.ones(4),),
+                max_iter=40, min_iter=10)
+key = ("fit", None, (), _abstract_sig(((jnp.ones(4),), {{}})))
+print(json.dumps({{"program_cache": r.timings["program_cache"],
+                   "key_hash": _key_hash(key)}}))
+"""
+
+
+def test_two_process_disk_hit_and_cross_process_key_hash(tmp_path):
+    """Worker A compiles and persists; worker B — a genuinely separate
+    process — deserializes instead of compiling, and the canonical
+    ``_key_hash`` of an identical logical key matches across the two
+    processes (the pert_trace correlation contract)."""
+    script = _CHILD.format(root=REPO_ROOT, store=str(tmp_path))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def run():
+        proc = subprocess.run([sys.executable, "-c", script], env=env,
+                              capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, proc.stderr
+        import json
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    a = run()
+    assert a["program_cache"] == "miss"
+    b = run()
+    assert b["program_cache"] == "disk_hit"
+    assert a["key_hash"] == b["key_hash"]
+
+
+# -- the double-compile race fix -------------------------------------------
+
+class _CountingTarget:
+    """Stands in for the jitted target: lower()/compile() are slow
+    enough that both threads would historically race into XLA."""
+
+    def __init__(self, fail_first=False):
+        self.lowers = 0
+        self.fail_first = fail_first
+        self._lock = threading.Lock()
+
+    def lower(self, loss_fn, *args, **kwargs):
+        with self._lock:
+            self.lowers += 1
+            n = self.lowers
+        time.sleep(0.15)
+        if self.fail_first and n == 1:
+            raise RuntimeError("leader dies mid-compile")
+        return self
+
+    def compile(self):
+        time.sleep(0.1)
+        return lambda *a, **k: None
+
+
+def _resolve_concurrently(target, n_threads=4):
+    results, errors = [], []
+
+    def worker():
+        try:
+            results.append(svi._resolve_program(
+                target, "fit", _quad_loss, (jnp.ones(3),), {}, {}, {}))
+        except Exception as exc:  # noqa: BLE001 — asserted below
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    return results, errors
+
+
+def test_concurrent_cold_misses_compile_once():
+    target = _CountingTarget()
+    results, errors = _resolve_concurrently(target)
+    assert errors == []
+    assert len(results) == 4
+    assert len({id(r) for r in results}) == 1     # all got THE program
+    assert target.lowers == 1                     # one XLA invocation
+
+
+def test_followers_retry_when_leader_dies():
+    target = _CountingTarget(fail_first=True)
+    results, errors = _resolve_concurrently(target)
+    # exactly one thread (the first leader) saw the failure; a follower
+    # took over, compiled, and the rest shared its program
+    assert len(errors) == 1
+    assert len(results) == 3
+    assert len({id(r) for r in results}) == 1
+    assert target.lowers == 2

@@ -207,7 +207,7 @@ def test_config_digest_invariant_to_non_hash_fields():
         base, telemetry_path="/elsewhere/run.ndjson",
         metrics_textfile="/elsewhere/metrics.prom",
         request_id="req-42", trace_spans=True, trace_parent="aaaa:bbbb",
-        slab_width=4)
+        slab_width=4, executable_cache_dir="/elsewhere/exec_cache")
     # the replacement above must exercise EVERY declared excluded field
     changed = {f for f in NON_HASH_FIELDS
                if getattr(moved, f) != getattr(base, f)}
@@ -228,6 +228,68 @@ def test_head_collective_fabric_is_seen(head_ctx):
     assert len(g.collective_bearing) >= 5
     assert len(g.multiprocess_reachable) > len(g.collective_bearing)
     assert not g.parse_errors, g.parse_errors
+
+
+# -- the aot_disk_key certificate row (schema v2) --------------------------
+
+def test_aot_disk_key_row_present_and_covered(head_ctx):
+    """The persistent executable store's digest contract is certified:
+    every declared KEY_COMPONENT has covered provenance, and the
+    committed artifact carries the row."""
+    from scdna_replication_tools_tpu.infer import aotcache
+
+    row = head_ctx.identity_report.get("aot_disk_key")
+    assert row is not None
+    assert row["verdict"] == "covered", row
+    assert row["components"] == list(aotcache.KEY_COMPONENTS)
+    assert {i["name"] for i in row["identity_inputs"]} == \
+        set(aotcache.KEY_COMPONENTS)
+    committed = json.loads(ARTIFACT.read_text())
+    assert committed["aot_disk_key"] == row
+    # the store location itself must be hash-excluded (the digest
+    # embeds the config hash — hashing the location would
+    # self-invalidate a relocated store)
+    from scdna_replication_tools_tpu.config import NON_HASH_FIELDS
+    assert "executable_cache_dir" in NON_HASH_FIELDS
+
+
+def test_aot_disk_key_drift_gates_as_fl004():
+    """Two-way drift detection: a certified component missing from the
+    declared KEY_COMPONENTS (or vice versa) degrades to ``unknown:``
+    provenance and fires FL004 on the aot row."""
+    from tools.pertlint.flow import engine as eng
+    from tools.pertlint.flow import rules_flow
+
+    ctx = build_flow_context()
+    row = ctx.identity_report["aot_disk_key"]
+    # simulate a component the store stopped digesting
+    broken = dict(row)
+    broken["components"] = [c for c in row["components"]
+                            if c != "device-kind"]
+    broken["identity_inputs"] = [
+        i for i in row["identity_inputs"] if i["name"] != "device-kind"
+    ] + [{"name": "device-kind",
+          "provenance": ["unknown:certified component 'device-kind' is "
+                         "missing from infer/aotcache.py KEY_COMPONENTS"],
+          "classification": "incomplete"}]
+    ctx.identity_report["aot_disk_key"] = broken
+    rule = next(r for r in eng._flow_rules() if r.id == "FL004")
+    hits = [f for f in rule.check(ctx) if "[aot_disk_key]" in f.message]
+    assert len(hits) == 1, [f.message for f in rule.check(ctx)]
+    assert "device-kind" in hits[0].message
+    # engine-side: the provenance map itself cross-checks the literal
+    assert set(eng._AOT_KEY_PROVENANCE) == set(row["components"])
+    assert rules_flow._certified_rows(ctx.identity_report)[-1] is broken
+
+
+def test_aot_disk_key_slab_width_never_in_provenance(head_ctx):
+    """The slab<W> tag's width is covered by the abstract signature,
+    not by the hash-excluded config:slab_width placement field — a
+    config:slab_width atom would classify as a FL003 leak."""
+    row = head_ctx.identity_report["aot_disk_key"]
+    atoms = {a for i in row["identity_inputs"] for a in i["provenance"]}
+    assert "config:slab_width" not in atoms
+    assert "config:executable_cache_dir" not in atoms
 
 
 # -- the gate -------------------------------------------------------------

@@ -69,6 +69,50 @@ _SYNTHETIC_ENTRIES = {
                        "machine-checked contract"),
 }
 
+# provenance of each declared component of the persistent executable
+# store's digest (infer/aotcache.py KEY_COMPONENTS — read statically,
+# like NON_HASH_FIELDS).  The certificate is two-way: a declared
+# component without provenance here, or a certified component missing
+# from the declaration, degrades to an ``unknown:`` atom and gates as
+# FL004 — the disk-cache key can neither grow nor shrink silently.
+_AOT_KEY_PROVENANCE = {
+    "program-tag": ("program-tag",),
+    "loss-structure": ("model-spec",),
+    "optimizer-statics": ("config:learning_rate", "config:max_iter",
+                          "config:min_iter", "config:rel_tol",
+                          "config:fused_adam",
+                          "config:optimizer_state_dtype", "literal"),
+    "abstract-signature": ("config:pad_cells_to", "config:pad_loci_to",
+                           "config:cell_chunk", "bucket:cells",
+                           "bucket:loci", "data-shape"),
+    "config-digest": ("config-digest",),
+    "jax-version": ("jax-version",),
+    "jaxlib-version": ("jaxlib-version",),
+    "backend": ("env:backend",),
+    "device-kind": ("env:device-kind",),
+    "mesh-topology": ("env:mesh-topology",),
+}
+
+_AOT_KEY_NOTES = [
+    "digest of the persistent executable store (infer/aotcache.py): "
+    "canonical key text (tag, loss value, optimiser statics, abstract "
+    "signature) + environment facts + the PROGRAM-shaping config "
+    "digest (_config_digest over NON_HASH_FIELDS' complement, minus "
+    "config.AOT_EXECUTION_ONLY_FIELDS)",
+    "AOT_EXECUTION_ONLY_FIELDS (checkpoint_dir, profile_dir, "
+    "compile_cache_dir) are stripped from the digest's config hash: "
+    "they name where host-side artifacts land, never what XLA "
+    "compiles — the serve worker moves checkpoint_dir per request, "
+    "and a restarted worker must still disk-hit its predecessor's "
+    "executables",
+    "a slab<W> tag's width is an abstract-signature fact (the packed "
+    "leading dim of every lane-stacked argument), NOT a read of the "
+    "hash-excluded config:slab_width placement field",
+    "executable_cache_dir itself is hash-excluded by design: it names "
+    "WHERE executables persist, and the digest embedding the config "
+    "hash would self-invalidate a relocated store",
+]
+
 # per-entry provenance of the dynamic arg shapes/dtypes: the pad/chunk
 # knobs are hash-included config fields; the rest is the data itself
 _SHAPE_PROVENANCE = {
@@ -114,6 +158,62 @@ def non_hash_fields_of(graph: cg.PackageGraph) -> Tuple[str, ...]:
     if const is None:
         return ()
     return ident._tuple_of_strings(const) or ()
+
+
+def build_aot_key_report(graph: cg.PackageGraph,
+                         non_hash_fields: Tuple[str, ...]
+                         ) -> Optional[dict]:
+    """The ``aot_disk_key`` certificate row: the on-disk executable
+    store's digest components (infer/aotcache.py KEY_COMPONENTS, read
+    statically) cross-checked against ``_AOT_KEY_PROVENANCE``.  None
+    when the package has no aotcache module (fixture packages)."""
+    mod = graph.modules.get(f"{graph.package}.infer.aotcache")
+    if mod is None:
+        return None
+    const = mod.constants.get("KEY_COMPONENTS")
+    declared = (ident._tuple_of_strings(const) or ()) \
+        if const is not None else ()
+    inputs: Dict[str, Set[str]] = {}
+    for comp in declared:
+        atoms = _AOT_KEY_PROVENANCE.get(comp)
+        if atoms is None:
+            atoms = (f"unknown:KEY_COMPONENTS declares '{comp}' but "
+                     f"flow/engine.py _AOT_KEY_PROVENANCE certifies no "
+                     f"provenance for it",)
+        inputs[comp] = set(atoms)
+    for comp in _AOT_KEY_PROVENANCE:
+        if comp not in declared:
+            inputs[comp] = {
+                f"unknown:certified component '{comp}' is missing from "
+                f"infer/aotcache.py KEY_COMPONENTS — the disk digest "
+                f"no longer covers it"}
+    if const is None:
+        inputs["<KEY_COMPONENTS>"] = {
+            "unknown:infer/aotcache.py has no statically-readable "
+            "KEY_COMPONENTS literal"}
+    # the declared execution-only strip list (config.py), read
+    # statically like NON_HASH_FIELDS — recorded for provenance; the
+    # runner consumes the same constant when computing the digest
+    exec_only: Tuple[str, ...] = ()
+    cfg_mod = graph.modules.get(f"{graph.package}.config")
+    if cfg_mod is not None:
+        eo = cfg_mod.constants.get("AOT_EXECUTION_ONLY_FIELDS")
+        if eo is not None:
+            exec_only = ident._tuple_of_strings(eo) or ()
+    return {
+        "name": "aot_disk_key",
+        "store": f"{graph.package}.infer.aotcache",
+        "path": graph.rel_path(mod.path),
+        "line": getattr(const, "lineno", 1) if const is not None else 1,
+        "components": list(declared),
+        "execution_only_fields": list(exec_only),
+        "identity_inputs": [
+            {"name": k, "provenance": sorted(v),
+             "classification": ident._worst(v, non_hash_fields)}
+            for k, v in inputs.items()],
+        "verdict": ident.entry_verdict(inputs, non_hash_fields),
+        "notes": list(_AOT_KEY_NOTES),
+    }
 
 
 def _registry_names() -> List[str]:
@@ -184,13 +284,20 @@ def build_identity_report(graph: cg.PackageGraph,
                                          "deep registry entry has no "
                                          "identity mapping (extend "
                                          "flow/engine.py ENTRY_JIT)"))
-    return {
+    report = {
         "schema": ident.SCHEMA,
         "package": graph.package,
         "non_hash_fields": sorted(non_hash_fields),
         "jit_cache_key_includes_jax_version": True,
         "entries": entries,
     }
+    # the persistent executable store's digest contract rides the same
+    # certificate (schema v2): absent for packages without an aotcache
+    # module, so fixture runs and their pins are untouched
+    aot = build_aot_key_report(graph, non_hash_fields)
+    if aot is not None:
+        report["aot_disk_key"] = aot
+    return report
 
 
 def _unmapped(graph: cg.PackageGraph, name: str, why: str) -> dict:
@@ -248,12 +355,15 @@ def run_flow_rules(select: Optional[Set[str]] = None,
     for rule in rules:
         findings.extend(rule.check(ctx))
     report = ctx.identity_report
+    rows = list(report["entries"])
+    if report.get("aot_disk_key"):
+        rows.append(report["aot_disk_key"])
     stats = FlowStats(
         modules=len(ctx.graph.modules),
         functions=len(ctx.graph.functions),
         collective_bearing=len(ctx.graph.collective_bearing),
-        entries=[e["name"] for e in report["entries"]],
-        verdicts={e["name"]: e["verdict"] for e in report["entries"]},
+        entries=[e["name"] for e in rows],
+        verdicts={e["name"]: e["verdict"] for e in rows},
         identity_report=report)
     return findings, stats
 

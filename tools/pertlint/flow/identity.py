@@ -32,6 +32,13 @@ Provenance atom vocabulary (strings in the report):
 * ``jax-version``     — jax's own version (jit keys on it natively)
 * ``layout-contract`` — the sharding layout factory (DP006/DP007's
   machine-checked contract)
+* ``program-tag`` / ``optimizer-statics`` / ``config-digest`` /
+  ``jaxlib-version`` / ``env:<fact>`` — components of the persistent
+  executable store's digest (infer/aotcache.py KEY_COMPONENTS; the
+  ``aot_disk_key`` certificate row, schema v2): the resolver tag, the
+  static optimiser kwargs, the behavioural-config hash restricted to
+  NON_HASH_FIELDS' complement, and the load-time-revalidated
+  environment facts (backend, device kind, mesh topology)
 * ``api:<fn>:<param>``    — a caller-supplied public-API input with no
   in-package binding (incomplete for cache-key purposes)
 * ``unknown:<what>``  — the analysis could not resolve it (incomplete)
@@ -49,7 +56,7 @@ from tools.pertlint.flow.callgraph import (
     dotted_name,
 )
 
-SCHEMA = "pert-program-identity/v1"
+SCHEMA = "pert-program-identity/v2"
 
 _WRAPPERS = {"int", "float", "str", "bool", "min", "max", "len", "round",
              "tuple", "abs", "sorted"}

@@ -173,6 +173,17 @@ def _text(node) -> str:
         return "<expr>"
 
 
+def _certified_rows(report: dict) -> list:
+    """Every certificate row FL003/FL004 must police: the per-entry
+    jit rows plus — when present — the persistent executable store's
+    ``aot_disk_key`` digest row (same shape by construction)."""
+    rows = list(report.get("entries", []))
+    aot = report.get("aot_disk_key")
+    if aot:
+        rows.append(aot)
+    return rows
+
+
 def _excluded_reads(expr: ast.expr, fn: cg.FunctionInfo,
                     tainted: Dict[str, Set[str]],
                     non_hash: Tuple[str, ...]) -> Set[str]:
@@ -201,8 +212,9 @@ class ExcludedFieldReachesIdentity(FlowRule):
 
     def check(self, ctx: FlowContext) -> Iterable[Finding]:
         # (a) the per-entry-point certificate: leaks visible in the
-        # static-argname provenance of any registered jit entry
-        for entry in ctx.identity_report.get("entries", []):
+        # static-argname provenance of any registered jit entry (or in
+        # the aot_disk_key digest components)
+        for entry in _certified_rows(ctx.identity_report):
             for inp in entry["identity_inputs"]:
                 leaked = [a.split(":", 1)[1] for a in inp["provenance"]
                           if a.startswith("config:")
@@ -337,7 +349,7 @@ class CacheKeyIncomplete(FlowRule):
                    "would not imply equal executables")
 
     def check(self, ctx: FlowContext) -> Iterable[Finding]:
-        for entry in ctx.identity_report.get("entries", []):
+        for entry in _certified_rows(ctx.identity_report):
             bad = [(inp["name"],
                     [a for a in inp["provenance"]
                      if a.startswith(("unknown:", "api:"))])

@@ -27,7 +27,11 @@ Two follow-on sessions ride the now-warm program cache:
    must be a zero-miss cache hit (the slab program compiled once,
    for the whole session);
 6. **shared spool** — two workers drain ONE spool concurrently:
-   rename-based claiming means each request lands exactly once.
+   rename-based claiming means each request lands exactly once;
+7. **restart** — a NEW worker (empty in-process program cache) over
+   the base session's spool: its first same-bucket request pays ZERO
+   XLA compiles, deserializing every program from the persistent
+   executable store the first worker left behind.
 
 Writes a JSON verdict (``--out``), copies r3's RunLog to
 ``<workdir>/warm_request.jsonl`` (the CI fleet-regress step gates its
@@ -278,6 +282,32 @@ def main(argv=None) -> int:
         o["status"] == "ok" for st in sstats for o in st["outcomes"]),
         "shared spool: both requests ok")
 
+    # -- restart: a NEW worker on the pre-warmed spool ---------------------
+    # the base session's worker persisted every compiled executable
+    # into <spool>/exec_cache (the "auto" default).  A restarted worker
+    # has an empty in-process program cache — simulated here by
+    # clearing it and deactivating the store binding — but its first
+    # same-bucket request must pay ZERO XLA compiles: every program
+    # resolution deserializes from the disk store (cache="disk_hit").
+    from scdna_replication_tools_tpu.infer import aotcache as _aotcache
+    from scdna_replication_tools_tpu.infer import svi as _svi
+
+    _svi.clear_program_cache()
+    _aotcache.deactivate()
+    rr = queue.submit_frames(*sim_a, options=REQUEST_OPTIONS,
+                             request_id="rr_restart")
+    rworker = ServeWorker(queue, buckets=buckets, max_requests=1,
+                          exit_when_idle=True)
+    rstats = rworker.run()
+    r_by_id = {o["request_id"]: o for o in rstats["outcomes"]}
+    check(r_by_id.get(rr, {}).get("status") == "ok",
+          "restart: first request on the restarted worker ok")
+    rr_cache = r_by_id.get(rr, {}).get("compile_cache") or {}
+    check(rr_cache.get("cache_misses") == 0
+          and (rr_cache.get("disk_hits") or 0) > 0,
+          "restart: zero XLA compiles — every program deserialized "
+          f"from the executable store (ledger: {rr_cache})")
+
     # stable copy of the warm request's log for the CI fleet gate
     if r3_log:
         shutil.copy(r3_log, workdir / "warm_request.jsonl")
@@ -309,6 +339,7 @@ def main(argv=None) -> int:
             "refill_compile_cache": b3_cache,
         },
         "shared_spool": {"served": sorted(served_ids)},
+        "restart": {"compile_cache": rr_cache},
     }
     print(json.dumps(verdict))
     if args.out:

@@ -114,6 +114,15 @@ battery() {  # returns 0 only if every step it attempted succeeded
         python bench.py --serve-ab --platform tpu \
             --ab-out artifacts/BENCH_r12_serve_ab_tpu.json \
             --metrics-textfile artifacts/METRICS_serve_tpu.prom || return 1
+    # executable-store restart A/B on the chip (PR 18): a fresh-process
+    # worker over a warmed spool must serve its first request with
+    # ZERO XLA compiles — on TPU the skipped compile is the multi-
+    # second r5-profile cost, so the deserialize-vs-compile gap this
+    # stage records is the headline cold-start cut; the committed CPU
+    # artifact (BENCH_r18_aot_cold_cpu.json) is the regression anchor
+    run_one BENCH_r18_aot_restart_tpu platform 2400 \
+        python bench.py --serve-ab --restart --platform tpu \
+            --ab-out artifacts/BENCH_r18_aot_restart_tpu.json || return 1
     run_one FULL_PIPELINE_r06_rescue_tpu platform 1500 \
         python tools/full_pipeline_bench.py --run-step3 --mirror-rescue \
             --checkpoint-dir artifacts/ckpt_r06_rescue $DURABLE \
