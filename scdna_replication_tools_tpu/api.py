@@ -132,7 +132,8 @@ class scRT:
                  rho_from_rt_prior=False, mirror_rescue=True,
                  compile_cache_dir='auto', executable_cache_dir=None,
                  telemetry_path='auto',
-                 metrics_textfile=None, fit_diag_every=25,
+                 metrics_textfile=None, heartbeat_dir='auto',
+                 heartbeat_interval_seconds=15.0, fit_diag_every=25,
                  qc=True, qc_entropy_thresh=0.5, qc_frac_thresh=0.25,
                  qc_ppc_replicates=8, qc_ppc_z=5.0,
                  controller=True, controller_max_extra_iters=None,
@@ -182,6 +183,8 @@ class scRT:
             executable_cache_dir=executable_cache_dir,
             telemetry_path=telemetry_path,
             metrics_textfile=metrics_textfile,
+            heartbeat_dir=heartbeat_dir,
+            heartbeat_interval_seconds=heartbeat_interval_seconds,
             fit_diag_every=fit_diag_every,
             qc=qc, qc_entropy_thresh=qc_entropy_thresh,
             qc_frac_thresh=qc_frac_thresh,
@@ -255,6 +258,9 @@ class scRT:
     # -- PERT (reference: infer_scRT.py:127-168) --------------------------
 
     def infer_pert_model(self):
+        from scdna_replication_tools_tpu.obs import (
+            heartbeat as heartbeat_mod,
+        )
         from scdna_replication_tools_tpu.obs import metrics as metrics_mod
         from scdna_replication_tools_tpu.obs.runlog import RunLog
         from scdna_replication_tools_tpu.utils.profiling import PhaseTimer
@@ -280,6 +286,11 @@ class scRT:
             # its own log/registry with per-request runs, and phase
             # seconds must never cross-feed between them
             metrics_mod.attach_phase_sink(timer, registry=registry)
+            # run-health heartbeat phase notes ride the same chain; the
+            # sink resolves the installed heartbeat at call time (the
+            # runner constructed below installs it), so attaching to
+            # the facade's timer here is enough for both drive styles
+            heartbeat_mod.attach_phase_sink(timer)
             self.metrics_registry = registry
             run_log = RunLog.create(self.config.telemetry_path)
         run_log.metrics_registry = registry

@@ -160,6 +160,18 @@ def infer_scrt_main(argv=None):
                         "metrics_snapshot events in the run log and the "
                         "fleet index (python -m tools.pert_fleet) work "
                         "without it")
+    p.add_argument("--heartbeat-dir", default="auto",
+                   help="live run-health heartbeats: every process "
+                        "atomically writes health/host_<rank>.json for "
+                        "tools/pert_watch.py; 'auto' (default) uses "
+                        "<checkpoint-dir>/health when checkpointing is "
+                        "on, a path targets a directory, 'none' "
+                        "disables (PertConfig.heartbeat_dir)")
+    p.add_argument("--heartbeat-interval", type=float, default=15.0,
+                   help="seconds between heartbeat writes "
+                        "(PertConfig.heartbeat_interval_seconds); the "
+                        "watcher derives its freshness ladder from "
+                        "this declared cadence")
     p.add_argument("--qc", action=BooleanOptionalAction, default=True,
                    help="model-health QC: posterior-confidence maps, "
                         "convergence doctor, posterior-predictive checks "
@@ -219,6 +231,8 @@ def infer_scrt_main(argv=None):
                 executable_cache_dir=args.executable_cache,
                 telemetry_path=args.telemetry,
                 metrics_textfile=args.metrics_textfile,
+                heartbeat_dir=args.heartbeat_dir,
+                heartbeat_interval_seconds=args.heartbeat_interval,
                 qc=args.qc, qc_entropy_thresh=args.qc_entropy_thresh,
                 qc_ppc_z=args.qc_ppc_z,
                 controller=args.controller,

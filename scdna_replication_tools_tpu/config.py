@@ -40,6 +40,9 @@ NON_HASH_FIELDS = (
                              # config digest, so hashing the store
                              # location would self-invalidate a moved
                              # store (infer/aotcache.py key contract)
+    "heartbeat_dir",        # where live health heartbeats land
+    "heartbeat_interval_seconds",  # heartbeat cadence — pure
+                                   # observability, like telemetry_path
 )
 
 # Fields that legitimately belong in the config content hash (they
@@ -318,6 +321,24 @@ class PertConfig:
     # RunLog events and the fleet index (tools/pert_fleet.py) work
     # either way.  Excluded from the config hash like telemetry_path.
     metrics_textfile: Optional[str] = None
+    # live run-health heartbeats (obs/heartbeat.py; OBSERVABILITY.md
+    # "Run health"): EVERY process — not just rank 0, unlike the
+    # RunLog — atomically publishes ``health/host_<rank>.json`` with
+    # step/chunk/iteration progress, a ms/iter EWMA + ETA, the
+    # controller verdict trail, HBM + fault-ladder counters and a
+    # monotonic sequence number; tools/pert_watch.py aggregates all
+    # hosts into one mission-control view and gates on the checked-in
+    # alert rules.  'auto' (default) places ``health/`` inside
+    # checkpoint_dir when one is set (the durable run dir a watcher on
+    # another machine can see) and disables otherwise; a path targets
+    # a specific directory; None/'none'/'off' disables.  Excluded from
+    # the config hash like telemetry_path — pure observability.
+    heartbeat_dir: Optional[str] = "auto"
+    # seconds between heartbeat writes (fault-ladder events force an
+    # immediate write regardless).  Stamped into each document so the
+    # watcher derives its freshness ladder from the writer's own
+    # declared cadence — no shared config needed.
+    heartbeat_interval_seconds: float = 15.0
     # in-fit diagnostics sampling stride (infer/svi.py ring buffer):
     # every K iterations the compiled loop records loss + global
     # grad/param norms on device (no host sync; last 64 samples kept,

@@ -54,6 +54,7 @@ from scdna_replication_tools_tpu.models.pert import (
     pert_loss,
     ppc_discrepancy,
 )
+from scdna_replication_tools_tpu.obs import heartbeat as heartbeat_mod
 from scdna_replication_tools_tpu.obs import metrics as metrics_mod
 from scdna_replication_tools_tpu.obs.controller import ControllerPolicy
 from scdna_replication_tools_tpu.ops.gc import gc_features
@@ -268,6 +269,32 @@ class PertInference:
         # newest runner's config wins, so a resume run with faults=None
         # cannot inherit a previous run's plan in the same process
         faults_mod.install(faults_mod.resolve_plan(config.faults))
+        # live run-health heartbeat (obs/heartbeat.py): EVERY process
+        # publishes health/host_<rank>.json — unlike the RunLog, whose
+        # create() no-ops on rank > 0, the whole point is per-host
+        # visibility.  Installed process-wide (newest runner wins, like
+        # the registry and fault plan above); run() writes the terminal
+        # state on completion/Exception — BaseException (preemption)
+        # deliberately leaves the last heartbeat to go stale, which is
+        # how the watcher flags the host presumed-lost.
+        self._heartbeat = None
+        hb_dir = heartbeat_mod.resolve_dir(config.heartbeat_dir,
+                                           config.checkpoint_dir)
+        if hb_dir:
+            from scdna_replication_tools_tpu.obs.runlog import \
+                _config_digest as _hb_digest
+            from scdna_replication_tools_tpu.parallel.distributed import (
+                process_rank_and_count,
+            )
+
+            hb_rank, hb_count = process_rank_and_count()
+            self._heartbeat = heartbeat_mod.RunHeartbeat(
+                hb_dir,
+                interval_seconds=config.heartbeat_interval_seconds,
+                process_index=hb_rank, process_count=hb_count,
+                config_digest=_hb_digest(config))
+            heartbeat_mod.install(self._heartbeat)
+            heartbeat_mod.attach_phase_sink(self.phases)
         # durable run manifest (infer/manifest.py): the resume ledger of
         # the checkpoint directory — identity (config hash + data
         # fingerprint) decides whether existing checkpoints belong to
@@ -1831,12 +1858,25 @@ class PertInference:
             # telemetry-disabled runs get no run_end (and so no final
             # snapshot event) — the textfile export must still land
             self.metrics.write_textfile()
+        except Exception as exc:
+            # terminal heartbeat on ERROR only: a BaseException
+            # (SimulatedPreemption, KeyboardInterrupt, SIGKILL-adjacent
+            # teardown) must NOT write a terminal state — the stale
+            # heartbeat it leaves behind is exactly what pert_watch's
+            # freshness ladder flags as presumed-lost
+            if self._heartbeat is not None:
+                self._heartbeat.close("error", error=exc)
+                heartbeat_mod.uninstall(self._heartbeat)
+            raise
         finally:
             # a directly-driven runner owns its registry's lifetime; a
             # facade-owned registry outlives the runner (packaging and
             # the facade's own run_end still feed it)
             if self._owns_metrics:
                 metrics_mod.uninstall(self.metrics)
+        if self._heartbeat is not None:
+            self._heartbeat.close("done")
+            heartbeat_mod.uninstall(self._heartbeat)
         return step1, step2, step3
 
 

@@ -35,6 +35,7 @@ import optax
 
 from scdna_replication_tools_tpu.infer import aotcache as _aotcache
 from scdna_replication_tools_tpu.obs import doctor as _doctor
+from scdna_replication_tools_tpu.obs import heartbeat as _heartbeat
 from scdna_replication_tools_tpu.obs import runlog as _runlog
 from scdna_replication_tools_tpu.ops import adam_kernel as _adam_kernel
 from scdna_replication_tools_tpu.utils import faults as _faults
@@ -1245,7 +1246,16 @@ def _chunk_loop(*, run_chunk, params, opt_state, losses, diag, i_host,
     def _chunk_span(entry_it, i_now, action, verdict=None):
         """One completed fit/chunk span carrying the controller's
         verdict for the pass; everything but the wall-clock interval is
-        deterministic content."""
+        deterministic content.  Every chunk outcome path calls this
+        exactly once, so it is also the heartbeat pump site: progress,
+        the ms/iter EWMA sample and the verdict trail ride the
+        process-global seam (a no-op when heartbeats are off), on EVERY
+        rank — unlike the RunLog, which rank 0 alone writes."""
+        _heartbeat.note_chunk(
+            step=escalate_tag, chunk=chunks_done, iteration=int(i_now),
+            budget=int(budget), wall_seconds=chunk_t1 - chunk_t0,
+            iters=int(i_now) - int(entry_it), action=str(action),
+            verdict=verdict)
         if tracer is None:
             return
         attrs = dict(chunk=chunks_done, iter_start=int(entry_it),

@@ -35,6 +35,7 @@ import threading
 import time
 from typing import Optional
 
+from scdna_replication_tools_tpu.obs import heartbeat as _heartbeat
 from scdna_replication_tools_tpu.obs import metrics as _metrics
 from scdna_replication_tools_tpu.utils import profiling
 from scdna_replication_tools_tpu.utils.profiling import logger
@@ -495,6 +496,12 @@ class RunLog:
         registry = self.metrics_registry if self.metrics_registry \
             is not None else _metrics.current()
         registry.record_event(event, payload)
+        # the run-health seam rides the same pre-gating spot: fault-
+        # ladder events (retry/degrade/fault_injected/resume) force an
+        # immediate heartbeat write on EVERY rank — rank > 0 logs are
+        # disabled, but their emits still pass here.  No-op (one
+        # module-global read) when no heartbeat is installed.
+        _heartbeat.observe_event(event, payload)
         with self._emit_lock:
             if not self.enabled or not self._open:
                 return

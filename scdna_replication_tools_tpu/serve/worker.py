@@ -85,6 +85,7 @@ from typing import Optional
 
 import pandas as pd
 
+from scdna_replication_tools_tpu.obs import heartbeat as heartbeat_mod
 from scdna_replication_tools_tpu.obs import metrics as metrics_mod
 from scdna_replication_tools_tpu.obs import spans as spans_mod
 from scdna_replication_tools_tpu.obs.runlog import RunLog
@@ -103,7 +104,6 @@ from scdna_replication_tools_tpu.serve.slab import (
     SlabState,
 )
 from scdna_replication_tools_tpu.utils import faults as faults_mod
-from scdna_replication_tools_tpu.utils.fileio import atomic_write_bytes
 from scdna_replication_tools_tpu.utils.profiling import logger
 
 # The subset of scRT keyword arguments a request ticket may override.
@@ -237,6 +237,15 @@ class ServeWorker:
         self._bucket_ledger: dict = {}
         self._heartbeat_stop = threading.Event()
         queue.ensure_dirs()
+        # status.json rides the shared heartbeat primitive
+        # (obs/heartbeat.py): same atomic commit as before, plus the
+        # monotonic 'seq' stamp — so pert_watch's sequence-based
+        # freshness contract covers the serve surface too.  Constructed
+        # AFTER _read_prior_bucket_ledger below would be too late only
+        # for seq resumption, which reads the same file — order with
+        # the ledger snapshot is irrelevant (both read, neither writes)
+        self._status_file = heartbeat_mod.HeartbeatFile(
+            queue.status_path)
         # persistent AOT executable store (infer/aotcache.py): 'auto'
         # (default) keeps it NEXT TO THE SPOOL so a restarted / sibling
         # worker inherits every compiled program the fleet has paid
@@ -639,16 +648,14 @@ class ServeWorker:
         }
 
     def _write_status(self) -> None:
-        """Atomic heartbeat write (mkstemp + fsync + os.replace via
-        ``atomic_write_bytes``): a concurrent ``pert-serve status``
-        reader can never observe a torn document.  Never raises —
-        the status surface must not take down the worker."""
+        """Atomic heartbeat write through the shared primitive
+        (``obs.heartbeat.HeartbeatFile``: mkstemp + fsync + os.replace,
+        plus the monotonic ``seq`` stamp): a concurrent ``pert-serve
+        status`` reader can never observe a torn document, and a
+        watcher can detect a stalled worker by sequence alone.  Never
+        raises — the status surface must not take down the worker."""
         try:
-            doc = self._status_doc()
-            atomic_write_bytes(
-                self.queue.status_path,
-                (json.dumps(doc, indent=1, sort_keys=True)
-                 + "\n").encode())
+            self._status_file.write(self._status_doc())
         except Exception as exc:  # noqa: BLE001 — best-effort surface;
             # the worker log remains the durable record
             logger.debug("pert-serve: status.json write failed: %s", exc)

@@ -207,7 +207,9 @@ def test_config_digest_invariant_to_non_hash_fields():
         base, telemetry_path="/elsewhere/run.ndjson",
         metrics_textfile="/elsewhere/metrics.prom",
         request_id="req-42", trace_spans=True, trace_parent="aaaa:bbbb",
-        slab_width=4, executable_cache_dir="/elsewhere/exec_cache")
+        slab_width=4, executable_cache_dir="/elsewhere/exec_cache",
+        heartbeat_dir="/elsewhere/health",
+        heartbeat_interval_seconds=1.5)
     # the replacement above must exercise EVERY declared excluded field
     changed = {f for f in NON_HASH_FIELDS
                if getattr(moved, f) != getattr(base, f)}

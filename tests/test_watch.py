@@ -1,0 +1,472 @@
+"""The pert-watch run-health plane: heartbeat writer atomicity and
+sequence discipline (obs/heartbeat.py), the freshness ladder and
+multi-host aggregation (straggler spread, desync, presumed-lost),
+the declarative alert engine (obs/alerts.py + obs/alert_rules.json),
+and the ``pert_watch`` CLI exit-code / textfile contract.
+
+Everything here runs on synthesized heartbeat trees — the live
+two-process end-to-end loop (heartbeats pumped from a real fit, a
+preempted rank flagged presumed-lost before the survivor's collective
+dies) is ``tools/watch_smoke.py``, exercised by the CI watch-smoke
+step.
+"""
+
+import json
+import pathlib
+import sys
+import time
+
+import pytest
+
+from scdna_replication_tools_tpu.obs import alerts as alerts_mod
+from scdna_replication_tools_tpu.obs import heartbeat as hb
+from scdna_replication_tools_tpu.obs import metrics as metrics_mod
+from scdna_replication_tools_tpu.utils.profiling import PhaseTimer
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO_ROOT))
+
+from tools import pert_watch  # noqa: E402
+
+
+def _doc(rank, *, state="running", step="step2", chunk=3, iteration=60,
+         budget=100, interval=10.0, age=0.0, now=None, count=2,
+         eta=4.0, metrics=None):
+    """One synthetic heartbeat document, ``age`` seconds old."""
+    now = time.time() if now is None else now
+    return {
+        "kind": hb.HEARTBEAT_KIND, "version": hb.HEARTBEAT_VERSION,
+        "process_index": rank, "process_count": count, "state": state,
+        "interval_seconds": interval, "step": step, "chunk": chunk,
+        "iteration": iteration, "budget": budget,
+        "ms_per_iter_ewma": 12.0, "eta_seconds": eta,
+        "written_unix": now - age, "seq": 7,
+        "metrics": metrics or {},
+    }
+
+
+def _tree(tmp_path, docs):
+    """Write raw heartbeat docs into a health/ dir, bypassing
+    HeartbeatFile so tests control seq/written_unix exactly."""
+    health = tmp_path / "health"
+    health.mkdir(parents=True, exist_ok=True)
+    for doc in docs:
+        hb.host_path(health, doc["process_index"]).write_text(
+            json.dumps(doc))
+    return health
+
+
+# ---------------------------------------------------------------------------
+# HeartbeatFile: atomicity + sequence discipline
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_file_seq_monotonic_and_resumes(tmp_path):
+    path = tmp_path / "host_0.json"
+    f = hb.HeartbeatFile(path)
+    assert f.write({"a": 1}) == 1
+    assert f.write({"a": 2}) == 2
+    doc = json.loads(path.read_text())
+    assert doc["seq"] == 2 and doc["a"] == 2
+    assert doc["written_unix"] > 0
+    # a restarted writer resumes the sequence — it never moves backwards
+    f2 = hb.HeartbeatFile(path)
+    assert f2.write({"a": 3}) == 3
+    assert json.loads(path.read_text())["seq"] == 3
+
+
+def test_heartbeat_file_write_is_atomic_no_temp_litter(tmp_path):
+    """Every committed state is complete JSON and the directory never
+    accumulates temp files (atomic_write_bytes contract)."""
+    path = tmp_path / "host_0.json"
+    f = hb.HeartbeatFile(path)
+    for i in range(25):
+        f.write({"payload": "x" * (i * 40), "i": i})
+        doc = json.loads(path.read_text())  # parse must never fail
+        assert doc["i"] == i
+    assert [p.name for p in tmp_path.iterdir()] == ["host_0.json"]
+
+
+def test_heartbeat_file_never_raises_on_unwritable_path(tmp_path):
+    (tmp_path / "blocker").write_text("a file where a dir must go")
+    f = hb.HeartbeatFile(tmp_path / "blocker" / "host_0.json")
+    assert f.write({"a": 1}) is None  # swallowed, not raised
+
+
+def test_scan_health_skips_torn_and_foreign_files(tmp_path):
+    health = _tree(tmp_path, [_doc(0), _doc(1)])
+    (health / "host_2.json").write_text('{"kind": "pert_hear')  # torn
+    (health / "notes.txt").write_text("not a heartbeat")
+    rows = hb.scan_health(health)
+    assert [r["rank"] for r in rows] == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# freshness ladder
+# ---------------------------------------------------------------------------
+
+
+def test_freshness_ladder_from_writers_own_interval():
+    now = time.time()
+    for age, want in ((5.0, "fresh"), (29.0, "fresh"),
+                      (31.0, "lagging"), (99.0, "lagging"),
+                      (101.0, "stale"), (299.0, "stale"),
+                      (301.0, "presumed_lost")):
+        doc = _doc(0, interval=10.0, age=age, now=now)
+        assert hb.freshness(doc, now) == want, (age, want)
+
+
+def test_freshness_terminal_states_are_final_never_stale():
+    now = time.time()
+    for state in sorted(hb.TERMINAL_STATES):
+        doc = _doc(0, state=state, age=1e6, now=now)
+        assert hb.freshness(doc, now) == "final"
+
+
+def test_freshness_scales_with_declared_cadence():
+    """The same 60s age is fresh for a 30s writer, presumed-lost for a
+    sub-second writer — thresholds come from the document, not the
+    reader's config."""
+    now = time.time()
+    assert hb.freshness(_doc(0, interval=30.0, age=60.0, now=now),
+                        now) == "fresh"
+    assert hb.freshness(_doc(0, interval=0.5, age=60.0, now=now),
+                        now) == "presumed_lost"
+
+
+# ---------------------------------------------------------------------------
+# aggregation: stragglers, desync, missing ranks, seq stalls
+# ---------------------------------------------------------------------------
+
+
+def test_aggregate_straggler_spread_same_step(tmp_path):
+    now = time.time()
+    health = _tree(tmp_path, [
+        _doc(0, chunk=5, iteration=90, now=now),
+        _doc(1, chunk=2, iteration=40, now=now),
+    ])
+    agg = hb.aggregate_health(health, now=now)
+    assert agg["straggler_spread_chunks"] == 3
+    assert agg["straggler_spread_iters"] == 50
+    assert agg["desync"] is False
+    assert agg["missing_ranks"] == []
+    assert agg["worst_freshness"] == "fresh"
+
+
+def test_aggregate_desync_and_cross_step_spread_excluded(tmp_path):
+    """Running hosts in different steps is desync; chunk counters do
+    not compare across steps, so spread is computed within the modal
+    step only."""
+    now = time.time()
+    health = _tree(tmp_path, [
+        _doc(0, step="step3", chunk=1, iteration=5, now=now, count=3),
+        _doc(1, step="step2", chunk=9, iteration=95, now=now, count=3),
+        _doc(2, step="step2", chunk=9, iteration=95, now=now, count=3),
+    ])
+    agg = hb.aggregate_health(health, now=now)
+    assert agg["desync"] is True
+    assert agg["steps"] == ["step2", "step3"]
+    assert agg["straggler_spread_chunks"] == 0  # modal step2 group only
+
+
+def test_aggregate_missing_rank_and_presumed_lost(tmp_path):
+    now = time.time()
+    health = _tree(tmp_path, [
+        _doc(0, now=now, count=3),
+        _doc(1, interval=0.5, age=120.0, now=now, count=3),  # lost
+    ])
+    agg = hb.aggregate_health(health, now=now)
+    assert agg["process_count"] == 3
+    assert agg["missing_ranks"] == [2]
+    assert agg["worst_freshness"] == "presumed_lost"
+    assert agg["hosts"][1]["freshness"] == "presumed_lost"
+    assert agg["max_lag_seconds"] >= 119.0
+
+
+def test_aggregate_final_hosts_exempt_from_lag(tmp_path):
+    """A finished run left overnight: terminal docs are final, do not
+    drive max_lag, and never trip the staleness alarm."""
+    now = time.time()
+    health = _tree(tmp_path, [
+        _doc(0, state="done", age=7200.0, now=now),
+        _doc(1, state="done", age=7200.0, now=now),
+    ])
+    agg = hb.aggregate_health(health, now=now)
+    assert agg["worst_freshness"] == "final"
+    assert agg["max_lag_seconds"] == 0.0
+    assert agg["states"] == {"done": 2}
+
+
+# ---------------------------------------------------------------------------
+# RunHeartbeat writer: progress, EWMA/ETA sanity, lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_run_heartbeat_announces_immediately(tmp_path):
+    rh = hb.RunHeartbeat(tmp_path, interval_seconds=60.0,
+                         process_index=1, process_count=2)
+    doc = hb.read_heartbeat(hb.host_path(tmp_path, 1))
+    assert doc["state"] == "running" and doc["seq"] == 1
+    assert doc["process_count"] == 2
+    assert doc["interval_seconds"] == 60.0
+    rh.close("done")
+    assert hb.read_heartbeat(hb.host_path(tmp_path, 1))["state"] == "done"
+
+
+def test_run_heartbeat_eta_projection_sane(tmp_path):
+    rh = hb.RunHeartbeat(tmp_path, interval_seconds=0.0)
+    rh.note_chunk(step="step2", chunk=1, iteration=25, budget=100,
+                  wall_seconds=0.5, iters=25, action="continue",
+                  verdict="improving")
+    rh.pump(force=True)  # beat the write throttle for the assertion
+    doc1 = hb.read_heartbeat(hb.host_path(tmp_path, 0))
+    # 20 ms/iter x 75 remaining = 1.5s
+    assert doc1["ms_per_iter_ewma"] == pytest.approx(20.0)
+    assert doc1["eta_seconds"] == pytest.approx(1.5)
+    rh.note_chunk(step="step2", chunk=2, iteration=75, budget=100,
+                  wall_seconds=1.0, iters=50, action="continue",
+                  verdict="improving")
+    rh.pump(force=True)
+    doc2 = hb.read_heartbeat(hb.host_path(tmp_path, 0))
+    # ETA shrinks as iteration approaches budget; trail records verdicts
+    assert 0.0 < doc2["eta_seconds"] < doc1["eta_seconds"]
+    assert doc2["trail"][-1] == "it75:continue/improving"
+    rh.note_chunk(iteration=100, budget=100)
+    rh.pump(force=True)
+    assert hb.read_heartbeat(
+        hb.host_path(tmp_path, 0))["eta_seconds"] == 0.0
+
+
+def test_run_heartbeat_throttle_and_fault_event_force(tmp_path):
+    rh = hb.RunHeartbeat(tmp_path, interval_seconds=3600.0)
+    seq0 = hb.read_heartbeat(hb.host_path(tmp_path, 0))["seq"]
+    rh.note_chunk(step="step2", chunk=1, iteration=5, budget=10)
+    assert hb.read_heartbeat(
+        hb.host_path(tmp_path, 0))["seq"] == seq0  # throttled
+    rh.observe_event("retry", {})  # fault-ladder event forces a write
+    doc = hb.read_heartbeat(hb.host_path(tmp_path, 0))
+    assert doc["seq"] == seq0 + 1
+    assert doc["faults"] == {"retry": 1}
+    assert doc["iteration"] == 5  # the throttled note rode along
+    rh.observe_event("fit_summary", {})  # non-fault events do not
+    assert hb.read_heartbeat(hb.host_path(tmp_path, 0))["seq"] == seq0 + 1
+
+
+def test_run_heartbeat_samples_installed_registry(tmp_path):
+    reg = metrics_mod.MetricsRegistry()
+    metrics_mod.install(reg)
+    try:
+        reg.gauge("pert_device_hbm_peak_bytes").set(123.0)
+        reg.counter("pert_retries_total").inc(2)
+        reg.counter("pert_fit_iters_total").inc(50)  # not sampled
+        rh = hb.RunHeartbeat(tmp_path, interval_seconds=0.0)
+        rh.pump(force=True)
+        doc = hb.read_heartbeat(hb.host_path(tmp_path, 0))
+        assert doc["metrics"]["pert_device_hbm_peak_bytes"] == 123.0
+        assert doc["metrics"]["pert_retries_total"] == 2
+        assert "pert_fit_iters_total" not in doc["metrics"]
+        # the ETA gauge is pushed back into the registry on pump
+        rh.note_chunk(step="s", chunk=1, iteration=50, budget=100,
+                      wall_seconds=1.0, iters=50)
+        rh.pump(force=True)
+        snap = reg.snapshot(stable_only=False)
+        assert snap["pert_run_eta_seconds"]["value"] == pytest.approx(1.0)
+    finally:
+        metrics_mod.uninstall(reg)
+
+
+def test_module_seam_and_phase_sink_chain(tmp_path):
+    rh = hb.RunHeartbeat(tmp_path, interval_seconds=0.0)
+    hb.install(rh)
+    try:
+        assert hb.current() is rh
+        hb.note_chunk(step="step2", chunk=2, iteration=9, budget=10)
+        rh.pump(force=True)
+        assert hb.read_heartbeat(
+            hb.host_path(tmp_path, 0))["iteration"] == 9
+        timer = PhaseTimer()
+        calls = []
+        timer.on_add = lambda n, s: calls.append(n)
+        hb.attach_phase_sink(timer)
+        hb.attach_phase_sink(timer)  # re-attach is a no-op, no stacking
+        timer.on_add("load", 0.1)
+        assert calls == ["load"]  # prior sink still chained
+        rh.pump(force=True)
+        assert hb.read_heartbeat(
+            hb.host_path(tmp_path, 0))["phase"] == "load"
+    finally:
+        hb.uninstall(rh)
+    hb.note_chunk(step="x")  # no-op once uninstalled
+    assert hb.current() is None
+
+
+def test_resolve_dir_auto_requires_checkpoint_dir(tmp_path):
+    assert hb.resolve_dir("auto", None) is None
+    assert hb.resolve_dir("auto", str(tmp_path)) == str(
+        tmp_path / "health")
+    assert hb.resolve_dir(None, str(tmp_path)) is None
+    assert hb.resolve_dir("off", str(tmp_path)) is None
+    assert hb.resolve_dir(str(tmp_path / "h"), None) == str(
+        tmp_path / "h")
+
+
+# ---------------------------------------------------------------------------
+# alert engine
+# ---------------------------------------------------------------------------
+
+
+def _rules(*rules):
+    return alerts_mod.validate_rules({"rules": list(rules)})
+
+
+def test_checked_in_rule_file_validates():
+    rules = alerts_mod.load_rules()
+    names = [r["name"] for r in rules]
+    assert "host-presumed-lost" in names
+    assert "hosts-desynced" in names
+
+
+def test_rule_validation_rejects_unknown_metric_and_field():
+    with pytest.raises(alerts_mod.AlertRuleError, match="unknown metric"):
+        _rules({"name": "r", "kind": "threshold", "severity": "error",
+                "metric": "pert_no_such_metric", "op": ">", "value": 0})
+    with pytest.raises(alerts_mod.AlertRuleError, match="unknown field"):
+        _rules({"name": "r", "kind": "threshold", "severity": "error",
+                "field": "no_such_field", "op": ">", "value": 0})
+
+
+def test_rule_validation_rejects_bad_grammar():
+    with pytest.raises(alerts_mod.AlertRuleError, match="unknown kind"):
+        _rules({"name": "r", "kind": "vibes", "severity": "error"})
+    with pytest.raises(alerts_mod.AlertRuleError, match="duplicate"):
+        _rules({"name": "r", "kind": "desync", "severity": "error"},
+               {"name": "r", "kind": "desync", "severity": "warning"})
+    with pytest.raises(alerts_mod.AlertRuleError, match="unknown keys"):
+        _rules({"name": "r", "kind": "desync", "severity": "error",
+                "op": ">"})
+    with pytest.raises(alerts_mod.AlertRuleError,
+                       match="exactly one of"):
+        _rules({"name": "r", "kind": "threshold", "severity": "error",
+                "op": ">", "value": 1})
+    with pytest.raises(alerts_mod.AlertRuleError, match="max_level"):
+        _rules({"name": "r", "kind": "staleness", "severity": "error",
+                "max_level": "presumed_lost"})
+    with pytest.raises(alerts_mod.AlertRuleError, match="number"):
+        _rules({"name": "r", "kind": "threshold", "severity": "error",
+                "field": "eta_seconds", "op": ">", "value": True})
+
+
+def test_alert_staleness_fires_on_presumed_lost_only(tmp_path):
+    now = time.time()
+    health = _tree(tmp_path, [
+        _doc(0, now=now),
+        _doc(1, interval=0.5, age=120.0, now=now),
+    ])
+    agg = hb.aggregate_health(health, now=now)
+    verdicts = alerts_mod.evaluate(alerts_mod.load_rules(), agg)
+    fired = {v["name"]: v for v in verdicts if v["fired"]}
+    assert "host-presumed-lost" in fired
+    assert "host1" in fired["host-presumed-lost"]["detail"]
+    failing = alerts_mod.failing(verdicts)
+    assert [v["name"] for v in failing] == ["host-presumed-lost"]
+
+
+def test_alert_desync_absence_and_metric_threshold(tmp_path):
+    now = time.time()
+    health = _tree(tmp_path, [
+        _doc(0, step="step3", now=now, count=3,
+             metrics={"pert_nan_aborts_total": 2}),
+        _doc(1, step="step2", now=now, count=3),
+    ])
+    agg = hb.aggregate_health(health, now=now)
+    fired = {v["name"]: v for v in alerts_mod.evaluate(
+        alerts_mod.load_rules(), agg) if v["fired"]}
+    assert "hosts-desynced" in fired
+    assert "missing-heartbeats" in fired  # rank 2 never wrote
+    assert "nan-aborts" in fired
+    assert fired["nan-aborts"]["severity"] == "warning"
+
+
+def test_alert_healthy_and_finished_trees_are_quiet(tmp_path):
+    now = time.time()
+    rules = alerts_mod.load_rules()
+    health = _tree(tmp_path, [_doc(0, now=now), _doc(1, now=now)])
+    assert alerts_mod.failing(alerts_mod.evaluate(
+        rules, hb.aggregate_health(health, now=now))) == []
+    done = _tree(tmp_path / "d", [
+        _doc(0, state="done", age=9000.0, now=now),
+        _doc(1, state="done", age=9000.0, now=now)])
+    assert alerts_mod.failing(alerts_mod.evaluate(
+        rules, hb.aggregate_health(done, now=now))) == []
+
+
+# ---------------------------------------------------------------------------
+# pert_watch CLI: exit codes, textfile gauges, report rendering
+# ---------------------------------------------------------------------------
+
+
+def test_watch_check_exit_codes_and_textfile(tmp_path, capsys):
+    _tree(tmp_path, [_doc(0), _doc(1)])
+    prom = tmp_path / "watch.prom"
+    rc = pert_watch.main(["check", str(tmp_path),
+                          "--metrics-textfile", str(prom)])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["kind"] == "pert_watch_check"
+    assert out["failing"] == []
+    text = prom.read_text()
+    for name in ("pert_heartbeat_lag_seconds",
+                 "pert_straggler_spread_chunks",
+                 "pert_run_eta_seconds"):
+        assert name in text
+
+    stale = tmp_path / "stale"
+    _tree(stale, [_doc(0), _doc(1, interval=0.5, age=300.0)])
+    rc = pert_watch.main(["check", str(stale)])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "host-presumed-lost" in captured.err
+    assert json.loads(captured.out)["failing"] == ["host-presumed-lost"]
+
+
+def test_watch_check_empty_dir_fails_absence(tmp_path, capsys):
+    (tmp_path / "health").mkdir()
+    rc = pert_watch.main(["check", str(tmp_path)])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "missing-heartbeats" in captured.err
+
+
+def test_watch_once_renders_mission_control(tmp_path, capsys):
+    _tree(tmp_path, [_doc(0, chunk=5, iteration=90),
+                     _doc(1, chunk=2, iteration=40)])
+    rc = pert_watch.main(["watch", str(tmp_path), "--once"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "host0" in out and "host1" in out
+    assert "spread 3 chunks / 50 iters" in out
+    assert "ETA" in out
+
+
+def test_watch_report_markdown_and_pert_report_embed(tmp_path, capsys):
+    from tools.pert_report import _run_health_section
+
+    _tree(tmp_path, [_doc(0), _doc(1, interval=0.5, age=300.0)])
+    rc = pert_watch.main(["report", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "## Run health" in out
+    assert "presumed_lost" in out
+    assert "host-presumed-lost" in out  # the alert bullet names the rule
+    # pert_report embeds the same renderer, resolving health/ next to
+    # the run log; placeholder when no heartbeats exist
+    lines = _run_health_section(tmp_path / "run.jsonl")
+    assert any("presumed_lost" in ln for ln in lines)
+    empty = _run_health_section(tmp_path / "nowhere" / "run.jsonl")
+    assert any("no heartbeats" in ln for ln in empty)
+
+
+def test_resolve_health_dir_accepts_run_dir_or_health_dir(tmp_path):
+    health = _tree(tmp_path, [_doc(0)])
+    assert pert_watch.resolve_health_dir(str(tmp_path)) == health
+    assert pert_watch.resolve_health_dir(str(health)) == health

@@ -438,11 +438,33 @@ def _nan_section(aborts: list) -> list:
     return lines
 
 
-def render_report(path) -> str:
+def _run_health_section(path, health_dir=None) -> list:
+    """Live-run health rendered from the heartbeat plane (``health/``
+    next to the run log, or an explicit ``--health-dir``): per-host
+    heartbeat summary, straggler spread, alert verdicts — the same
+    renderer ``pert_watch report`` uses.  Placeholder when no
+    heartbeats exist (pre-watch runs, heartbeats off)."""
+    from scdna_replication_tools_tpu.obs import alerts as alerts_mod
+    from scdna_replication_tools_tpu.obs import heartbeat as hb_mod
+    from tools.pert_watch import render_health_markdown
+
+    if health_dir is None:
+        health_dir = pathlib.Path(str(path)).resolve().parent / "health"
+    aggregate = hb_mod.aggregate_health(health_dir)
+    try:
+        verdicts = alerts_mod.evaluate(alerts_mod.load_rules(),
+                                       aggregate)
+    except alerts_mod.AlertRuleError:
+        verdicts = []
+    return render_health_markdown(aggregate, verdicts)
+
+
+def render_report(path, health_dir=None) -> str:
     summary = summarize_run(path)
     if summary is None:
         raise SystemExit(f"pert_report: no readable events in {path}")
     lines = _header(summary)
+    lines += _run_health_section(path, health_dir)
     lines += _phase_waterfall(summary["phases"])
     lines += _spans_section(summary)
     lines += _fit_table(summary["fits"])
@@ -594,12 +616,16 @@ def main(argv=None):
                          "compile-cache pair) instead of rendering one")
     ap.add_argument("--out", default=None,
                     help="write the markdown here instead of stdout")
+    ap.add_argument("--health-dir", default=None,
+                    help="heartbeat health/ directory for the 'Run "
+                         "health' section (default: health/ next to "
+                         "the run log; placeholder when absent)")
     args = ap.parse_args(argv)
 
     if args.compare:
         report = render_compare(*args.compare)
     elif args.run:
-        report = render_report(args.run)
+        report = render_report(args.run, health_dir=args.health_dir)
     else:
         ap.print_usage(sys.stderr)
         raise SystemExit("pert_report: give a run log or --compare A B")

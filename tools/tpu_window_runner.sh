@@ -153,6 +153,35 @@ battery() {  # returns 0 only if every step it attempted succeeded
     return 0
 }
 
+# Run-health plane: every durable stage checkpoints under
+# artifacts/ckpt_*, so with heartbeat_dir=auto each one publishes
+# health/host_<rank>.json there.  While a battery runs, a background
+# loop appends one `pert_watch watch --once` frame per live health dir
+# to the log every 60s — a tunnel-window battery left overnight shows
+# WHERE it was (step/chunk/ETA, straggler spread, presumed-lost hosts)
+# instead of an opaque rc=124.  `pert_watch check` verdicts ride along
+# so a firing alert (hostloss, desync) is in the log the moment it
+# happens, not at post-mortem.
+health_snapshot() {
+    local dir
+    for dir in artifacts/ckpt_*/health; do
+        [ -d "$dir" ] || continue
+        {
+            echo "$(stamp) window-runner: run-health ${dir}"
+            timeout 60 python tools/pert_watch.py watch "$dir" --once
+            timeout 60 python tools/pert_watch.py check "$dir" \
+                > /dev/null || echo "window-runner: pert_watch check FAILING for ${dir}"
+        } >> "$LOG" 2>&1
+    done
+}
+
+health_watch_loop() {
+    while true; do
+        sleep 60
+        health_snapshot
+    done
+}
+
 core_done() {
     [ -s artifacts/BENCH_r06_tpu_300iter.json ] \
         && [ -s artifacts/BENCH_r06_tpu_10k.json ] \
@@ -164,7 +193,12 @@ core_done() {
 for attempt in $(seq 1 200); do
     if probe; then
         echo "$(stamp) window-runner: probe ok (attempt ${attempt}) - running battery" >> "$LOG"
+        health_watch_loop &
+        watch_pid=$!
         battery || true   # a failed step still falls through to sleep
+        kill "$watch_pid" 2>/dev/null
+        wait "$watch_pid" 2>/dev/null
+        health_snapshot   # final post-battery frame per stage
         if core_done && { [ -s artifacts/FULL_PIPELINE_r06_10k_tpu.json ] \
                           || [ "$tries_10k" -ge "$MAX_10K_TRIES" ]; }; then
             echo "$(stamp) window-runner: battery complete (10k tries=${tries_10k})" >> "$LOG"

@@ -1,0 +1,353 @@
+"""Watch smoke: the pert-watch run-health loop, end to end, twice.
+
+The CI face of the live run-health plane (obs/heartbeat.py +
+tools/pert_watch.py): two arms over the same 2-process
+``jax.distributed`` CPU workload (gloo collectives, one forced host
+device per process), each process publishing ``health/host_<rank>.json``
+heartbeats at a sub-second cadence:
+
+1. **healthy** — both hosts fit to completion.  While they run the
+   parent must see BOTH heartbeats live (the mission-control view
+   works mid-fit); afterwards both documents must be terminal
+   (``state: done`` — "final", exempt from staleness) and
+   ``pert_watch check`` must exit 0 with the three watch gauges in its
+   Prometheus textfile;
+2. **chaos** — same workload with ``preempt@step2/chunk#2@proc1``.
+   Host 1 dies by ``SimulatedPreemption`` (a BaseException — the
+   heartbeat's terminal write deliberately does NOT run, leaving the
+   last document in ``state: running``).  The parent polls the health
+   dir and must observe host 1 reach **presumed_lost** purely by
+   staleness WHILE host 0 is still alive in its doomed collective —
+   the pre-deadlock hostloss flag this plane exists for.  Afterwards
+   ``pert_watch check`` must exit non-zero naming
+   ``host-presumed-lost``.
+
+Emits one JSON verdict (``--out``) with a checks dict and exits 1 when
+any check fails, same shape as ``tools/chaos_smoke.py``.
+
+Usage::
+
+    python tools/watch_smoke.py --out watch_smoke.json
+    python tools/watch_smoke.py --arm chaos --report watch_health.md
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from tools.chaos_smoke import _free_port, _infer  # noqa: E402
+from tools.full_pipeline_bench import (  # noqa: E402
+    force_cpu_backend,
+    make_genome_workload,
+)
+
+_REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _mp_worker(args) -> int:
+    """One host of a 2-process fit with heartbeats on (spawned by the
+    parent; env already forces one host CPU device per process).
+
+    Exit codes: 0 = finished, 3 = died by the injected preemption,
+    4 = died collaterally (peer gone, collective failed)."""
+    from scdna_replication_tools_tpu.parallel.distributed import (
+        init_distributed,
+    )
+    from scdna_replication_tools_tpu.utils import faults as faults_mod
+
+    init_distributed(coordinator_address=args.coordinator,
+                     num_processes=2, process_id=args.mp_worker)
+    work = pathlib.Path(args.workdir)
+    df_s, df_g, _ = make_genome_workload(args.cells, args.g1_cells,
+                                         bin_size=args.bin_size, seed=0)
+    extra = {
+        "heartbeat_dir": str(work / "health"),
+        "heartbeat_interval_seconds": args.hb_interval,
+        "num_shards": 2, "elastic_mesh": False,
+        "watchdog_chunk_seconds": 60.0,
+    }
+    if args.faults:
+        extra["faults"] = args.faults
+    try:
+        _infer(df_s, df_g,
+               str(work / f"run.p{args.mp_worker}.jsonl"), **extra)
+    except faults_mod.SimulatedPreemption as exc:
+        print(f"watch-smoke worker {args.mp_worker}: preempted ({exc})",
+              file=sys.stderr)
+        return 3
+    except RuntimeError as exc:
+        # the post-fit dataframe decode fetches global arrays, which a
+        # multi-host run cannot do yet (the ROADMAP-1 decode gap; the
+        # mirror rescue is gated the same way).  The FIT completed iff
+        # this process's own heartbeat closed terminal "done" — which
+        # is exactly the ground truth this smoke exists to establish.
+        from scdna_replication_tools_tpu.obs import heartbeat as hb_mod
+
+        doc = hb_mod.read_heartbeat(
+            hb_mod.host_path(work / "health", args.mp_worker)) or {}
+        if doc.get("state") == "done" and "non-addressable" in str(exc):
+            print(f"watch-smoke worker {args.mp_worker}: fit done "
+                  "(multi-host output decode skipped)", file=sys.stderr)
+            return 0
+        print(f"watch-smoke worker {args.mp_worker}: died collaterally "
+              f"({type(exc).__name__}: {exc})", file=sys.stderr)
+        return 4
+    except BaseException as exc:  # noqa: BLE001 — the worker's whole
+        # job is to report HOW it died to the parent
+        print(f"watch-smoke worker {args.mp_worker}: died collaterally "
+              f"({type(exc).__name__}: {exc})", file=sys.stderr)
+        return 4
+    return 0
+
+
+def _spawn_pair(args, work: pathlib.Path, faults: str | None):
+    port = _free_port()
+    procs = []
+    for k in range(2):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=1"
+                            ).strip()
+        env.pop("PERT_FAULTS", None)
+        cmd = [sys.executable, __file__, "--mp-worker", str(k),
+               "--coordinator", f"127.0.0.1:{port}",
+               "--workdir", str(work), "--cells", str(args.cells),
+               "--g1-cells", str(args.g1_cells),
+               "--bin-size", str(args.bin_size),
+               "--hb-interval", str(args.hb_interval)]
+        if faults:
+            cmd += ["--faults", faults]
+        procs.append(subprocess.Popen(cmd, env=env, cwd=str(_REPO_ROOT)))
+    return procs
+
+
+def _wait_all(procs, timeout: float) -> list:
+    codes = []
+    deadline = time.monotonic() + timeout
+    for p in procs:
+        try:
+            codes.append(p.wait(timeout=max(deadline - time.monotonic(),
+                                            1.0)))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            codes.append(p.wait())
+            print("watch_smoke: killed a hung worker (timeout)",
+                  file=sys.stderr)
+    return codes
+
+
+def _run_check(health_dir, textfile=None):
+    """``pert_watch check`` as CI runs it — a real subprocess, so the
+    exit-code contract is what's exercised."""
+    cmd = [sys.executable, str(_REPO_ROOT / "tools" / "pert_watch.py"),
+           "check", str(health_dir)]
+    if textfile:
+        cmd += ["--metrics-textfile", str(textfile)]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                         cwd=str(_REPO_ROOT))
+    try:
+        doc = json.loads(res.stdout)
+    except ValueError:
+        doc = {}
+    return res.returncode, doc, res.stderr
+
+
+def _watch_frame(health_dir) -> str:
+    from scdna_replication_tools_tpu.obs import alerts as alerts_mod
+    from scdna_replication_tools_tpu.obs import heartbeat as hb_mod
+    from tools.pert_watch import render_view
+
+    agg = hb_mod.aggregate_health(health_dir)
+    verdicts = alerts_mod.evaluate(alerts_mod.load_rules(), agg)
+    return render_view(health_dir, agg, verdicts)
+
+
+def _healthy_arm(args, work: pathlib.Path) -> dict:
+    from scdna_replication_tools_tpu.obs import heartbeat as hb_mod
+
+    health = work / "health"
+    procs = _spawn_pair(args, work, faults=None)
+    # live visibility: both heartbeats must appear while the fit runs
+    saw_both_live = False
+    while any(p.poll() is None for p in procs):
+        agg = hb_mod.aggregate_health(health)
+        if agg["hosts_seen"] >= 2:
+            saw_both_live = True
+            break
+        time.sleep(0.5)
+    codes = _wait_all(procs, timeout=600)
+    print(_watch_frame(health), file=sys.stderr)
+    states = {r["rank"]: r["doc"].get("state")
+              for r in hb_mod.scan_health(health)}
+    prom = work / "watch.prom"
+    rc, doc, err = _run_check(health, textfile=prom)
+    text = prom.read_text() if prom.exists() else ""
+    return {
+        "exit_codes": codes,
+        "checks": {
+            "healthy_workers_finished_clean": codes == [0, 0],
+            "healthy_live_saw_both_hosts": saw_both_live,
+            "healthy_both_hosts_done": states == {0: "done", 1: "done"},
+            "healthy_check_green": rc == 0
+            and doc.get("failing") == [],
+            "healthy_textfile_has_watch_gauges": all(
+                name in text for name in (
+                    "pert_heartbeat_lag_seconds",
+                    "pert_straggler_spread_chunks",
+                    "pert_run_eta_seconds")),
+        },
+    }
+
+
+def _chaos_arm(args, work: pathlib.Path) -> dict:
+    from scdna_replication_tools_tpu.obs import heartbeat as hb_mod
+
+    health = work / "health"
+    procs = _spawn_pair(args, work,
+                        faults=f"preempt@{args.kill_at}@proc1")
+    # poll for the hostloss flag: host 1's heartbeat must age through
+    # the ladder to presumed_lost while host 0 still lives in its
+    # doomed collective (detection BEFORE the run is over)
+    detected = False
+    survivor_alive_at_detection = False
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        rows = {h["rank"]: h
+                for h in hb_mod.aggregate_health(health)["hosts"]}
+        lost = rows.get(1)
+        if lost is not None and lost["freshness"] == "presumed_lost":
+            detected = True
+            survivor_alive_at_detection = procs[0].poll() is None
+            break
+        if all(p.poll() is not None for p in procs) \
+                and lost is not None \
+                and lost["doc"].get("state") in hb_mod.TERMINAL_STATES:
+            break  # scenario bug: the preempted rank wrote a terminal doc
+        time.sleep(0.5)
+    frame = _watch_frame(health)
+    print(frame, file=sys.stderr)
+    codes = _wait_all(procs, timeout=600)
+    host1 = hb_mod.read_heartbeat(hb_mod.host_path(health, 1)) or {}
+    rc, doc, err = _run_check(health)
+    return {
+        "exit_codes": codes,
+        "check_stderr": err.strip(),
+        "checks": {
+            "chaos_proc1_died_by_preemption": codes[1] == 3,
+            "chaos_lost_host_left_running_state":
+                host1.get("state") == "running",
+            "chaos_presumed_lost_detected": detected,
+            "chaos_detected_before_run_exit":
+                survivor_alive_at_detection,
+            "chaos_watch_frame_flags_lost": "PRESUMED-LOST" in frame,
+            "chaos_check_fails_naming_staleness": rc != 0
+            and "host-presumed-lost" in (doc.get("failing") or []),
+        },
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cells", type=int, default=32)
+    ap.add_argument("--g1-cells", type=int, default=16)
+    ap.add_argument("--bin-size", type=int, default=5_000_000,
+                    help="smoke default: a coarse ~620-bin genome keeps "
+                         "both arms CI-cheap")
+    ap.add_argument("--hb-interval", type=float, default=0.25,
+                    help="heartbeat cadence for the workers; the "
+                         "presumed-lost threshold is 30x this, so it "
+                         "must be small enough to trip while the "
+                         "survivor's watchdog (60s) still has it alive")
+    ap.add_argument("--kill-at", default="step2/chunk#2",
+                    help="fault site of the chaos arm's preemption")
+    ap.add_argument("--arm", choices=("healthy", "chaos", "both"),
+                    default="both")
+    ap.add_argument("--workdir", default=None,
+                    help="scratch dir (default: a fresh temp dir)")
+    ap.add_argument("--out", default=None, help="JSON verdict path")
+    ap.add_argument("--report", default=None,
+                    help="write the final 'Run health' markdown of the "
+                         "last arm here (the CI artifact)")
+    ap.add_argument("--mp-worker", type=int, default=None,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--coordinator", default=None,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--faults", default=None, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.mp_worker is not None:
+        return _mp_worker(args)
+
+    force_cpu_backend()
+
+    root = pathlib.Path(args.workdir) if args.workdir \
+        else pathlib.Path(tempfile.mkdtemp(prefix="pert_watch_"))
+    root.mkdir(parents=True, exist_ok=True)
+
+    checks = {}
+    facts = {}
+    last_health = None
+    if args.arm in ("healthy", "both"):
+        print("watch_smoke: healthy arm (2-process fit, heartbeats "
+              f"every {args.hb_interval}s)...", file=sys.stderr)
+        work = root / "healthy"
+        work.mkdir(exist_ok=True)
+        facts["healthy"] = _healthy_arm(args, work)
+        checks.update(facts["healthy"].pop("checks"))
+        last_health = work / "health"
+    if args.arm in ("chaos", "both"):
+        print("watch_smoke: chaos arm "
+              f"(preempt@{args.kill_at}@proc1)...", file=sys.stderr)
+        work = root / "chaos"
+        work.mkdir(exist_ok=True)
+        facts["chaos"] = _chaos_arm(args, work)
+        checks.update(facts["chaos"].pop("checks"))
+        last_health = work / "health"
+
+    if args.report and last_health is not None:
+        from scdna_replication_tools_tpu.obs import alerts as alerts_mod
+        from scdna_replication_tools_tpu.obs import heartbeat as hb_mod
+        from tools.pert_watch import render_health_markdown
+
+        agg = hb_mod.aggregate_health(last_health)
+        verdicts = alerts_mod.evaluate(alerts_mod.load_rules(), agg)
+        pathlib.Path(args.report).write_text(
+            "\n".join(render_health_markdown(agg, verdicts)) + "\n")
+
+    verdict = {
+        "metric": "watch_smoke_run_health_loop",
+        "arm": args.arm,
+        "hb_interval_seconds": args.hb_interval,
+        "kill_at": args.kill_at,
+        "checks": checks,
+        "facts": facts,
+        "ok": all(checks.values()),
+        "workdir": str(root),
+    }
+    print(json.dumps(verdict))
+    if args.out:
+        pathlib.Path(args.out).write_text(json.dumps(verdict, indent=1)
+                                          + "\n")
+    if not verdict["ok"]:
+        failing = [k for k, v in checks.items() if not v]
+        print(f"watch_smoke: FAILED checks: {failing}", file=sys.stderr)
+        return 1
+    print("watch_smoke: OK — run-health loop holds on both arms",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
