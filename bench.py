@@ -834,6 +834,44 @@ def _serve_ab_workload(args):
     return cohorts, options
 
 
+def _arm_meter_block(ok, worker_meter=None):
+    """The cost plane of one serve arm: the worker-session ledger
+    (claim gaps, parked slab lanes) merged with every request's own
+    run-log meter — attributed device-seconds, goodput, the named
+    waste decomposition, a per-request cost list, and the conservation
+    check (billed == effective + sum(waste)) the committed artifact
+    certifies end-to-end."""
+    from scdna_replication_tools_tpu.obs.meter import conservation_gap
+    from tools.pert_meter import merge_meters, meter_of_run
+
+    per_request = []
+    meters = [worker_meter] if worker_meter else []
+    for o in ok:
+        m = meter_of_run(o["run_log"]) if o.get("run_log") else None
+        meters.append(m)
+        if m:
+            per_request.append({
+                "request_id": o["request_id"],
+                "billed_device_seconds": m.get("billed_device_seconds"),
+                "goodput": m.get(
+                    "goodput_cell_iters_per_device_second"),
+                "waste_frac": m.get("waste_frac"),
+            })
+    rollup = merge_meters(meters)
+    gap = conservation_gap(rollup)
+    return {
+        "device_seconds": rollup.get("billed_device_seconds"),
+        "effective_device_seconds": rollup.get(
+            "effective_device_seconds"),
+        "goodput": rollup.get("goodput_cell_iters_per_device_second"),
+        "waste_seconds": rollup.get("waste_seconds"),
+        "waste_frac": rollup.get("waste_frac"),
+        "per_request": per_request,
+        "conservation_gap": round(gap, 8),
+        "conservation_ok": gap <= 0.01,
+    }
+
+
 def _serve_ab_cold_arm(cohorts, options, workdir, platform):
     """The status quo: one cold CLI subprocess per request — every run
     pays interpreter + import + trace (and, with a cold disk cache,
@@ -842,6 +880,7 @@ def _serve_ab_cold_arm(cohorts, options, workdir, platform):
     from scdna_replication_tools_tpu.obs.summary import summarize_run
 
     latencies, hits, misses = [], 0, 0
+    run_rows = []
     # force CPU only when the A/B itself is a CPU run: on TPU the cold
     # subprocesses must inherit the ambient backend, or the stage would
     # compare a warm-TPU worker against cold-CPU runs — invalidating
@@ -874,6 +913,8 @@ def _serve_ab_cold_arm(cohorts, options, workdir, platform):
                 f"cold CLI run {i} failed (rc={proc.returncode}): "
                 f"{proc.stderr[-400:]}")
         latencies.append(wall)
+        run_rows.append({"request_id": f"cold_{i}",
+                         "run_log": str(log_path)})
         comp = (summarize_run(log_path) or {}).get("compile") or {}
         hits += int(comp.get("cache_hits") or 0)
         misses += int(comp.get("cache_misses") or 0)
@@ -881,6 +922,7 @@ def _serve_ab_cold_arm(cohorts, options, workdir, platform):
     return {
         "arm": "cold_cli",
         "requests": len(latencies),
+        "meter": _arm_meter_block(run_rows),
         "total_wall_seconds": round(total, 2),
         "requests_per_second": round(len(latencies) / max(total, 1e-9),
                                      4),
@@ -944,6 +986,7 @@ def _serve_ab_warm_arm(cohorts, options, workdir, args):
     return {
         "arm": "warm_worker",
         "requests": len(ok),
+        "meter": _arm_meter_block(ok, stats.get("meter")),
         "span_waterfalls": waterfalls,
         "total_wall_seconds": round(total, 2),
         "requests_per_second": round(len(ok) / max(total, 1e-9), 4),
@@ -1042,6 +1085,7 @@ def _serve_burst_arm(cohorts, options, workdir, args, max_batch, tag):
         "arm": tag,
         "max_batch": max_batch,
         "requests": len(ok),
+        "meter": _arm_meter_block(ok, stats.get("meter")),
         "total_wall_seconds": round(total, 2),
         "requests_per_second": round(len(ok) / max(total, 1e-9), 4),
         "latency_p50_seconds": round(p50, 2),
@@ -1135,6 +1179,15 @@ def run_serve_burst(args):
                 / max(batched["latency_p99_seconds"], 1e-9), 2),
             "p99_over_p50_serial": serial["p99_over_p50"],
             "p99_over_p50_batched": batched["p99_over_p50"],
+            # the cost plane's verdict on the same A/B: attributed
+            # device-seconds per request and goodput, not just wall
+            "device_seconds_ratio": round(
+                (batched["meter"]["device_seconds"] or 0.0)
+                / max(serial["meter"]["device_seconds"] or 0.0, 1e-9),
+                3),
+            "goodput_ratio": round(
+                (batched["meter"]["goodput"] or 0.0)
+                / max(serial["meter"]["goodput"] or 0.0, 1e-9), 3),
         },
         "note": "same burst in both arms, both warm (warmup pays the "
                 "compiles).  Serial drains the spool one request at a "

@@ -55,6 +55,7 @@ from scdna_replication_tools_tpu.models.pert import (
     ppc_discrepancy,
 )
 from scdna_replication_tools_tpu.obs import heartbeat as heartbeat_mod
+from scdna_replication_tools_tpu.obs import meter as meter_mod
 from scdna_replication_tools_tpu.obs import metrics as metrics_mod
 from scdna_replication_tools_tpu.obs.controller import ControllerPolicy
 from scdna_replication_tools_tpu.ops.gc import gc_features
@@ -211,6 +212,17 @@ class PertInference:
         # the log's final run_end snapshot comes from THIS registry —
         # and the emit seam routes every event this log records into it
         self.run_log.metrics_registry = self.metrics
+        # device-cost attribution ledger (obs/meter.py): rides the
+        # RunLog so every dispatch site (svi chunk loop, compile
+        # resolution, the decode/PPC slabs below) books billed
+        # device-seconds, waste and effective work against this run;
+        # close_run lands its summary as run_end's `meter` section
+        meter_scope = {"run": "pert"}
+        if config.request_id:
+            meter_scope["request"] = str(config.request_id)
+        self.meter = meter_mod.CostLedger(scope=meter_scope)
+        self.meter.metrics_registry = self.metrics
+        self.run_log.meter_ledger = self.meter
         # causal span tracing (obs/spans.py): wire a tracer onto the
         # log when the config asks for one and the caller (the facade)
         # has not already attached it — phases become spans through the
@@ -1099,7 +1111,9 @@ class PertInference:
         # that request, never the worker
         faults_mod.point(f"{step_name}/fit")
         t0 = time.perf_counter()
-        with profiling.trace(cfg.profile_dir):
+        with self.meter.context(step=step_name,
+                                **self._meter_attrs(step_name, batch)), \
+                profiling.trace(cfg.profile_dir):
             fit = fit_map(loss_fn, params0, (fixed, batch),
                           max_iter=max_iter, min_iter=min_iter,
                           rel_tol=cfg.rel_tol,
@@ -1176,6 +1190,26 @@ class PertInference:
         exactly the information for a diverged fit."""
         v = float(value)
         return v if np.isfinite(v) else None
+
+    def _meter_attrs(self, step_name: str, batch) -> dict:
+        """Cost-attribution context of one step's dispatches: the REAL
+        (unpadded) cell count — effective work units are real cells x
+        iterations — plus the bucket-contract ``pad_frac``, the billed
+        fraction burnt computing planes for padding cells/loci the
+        decode discards (step 3 fits the G1 axis; steps 1-2 the S
+        axis)."""
+        real = self.g1 if step_name == "step3" else self.s
+        padded_cells = int(batch.reads.shape[0])
+        padded_loci = int(batch.reads.shape[1])
+        real_cells = min(int(real.num_cells), padded_cells)
+        real_loci = min(int(real.num_loci), padded_loci)
+        pad_frac = 1.0 - (real_cells * real_loci) \
+            / max(padded_cells * padded_loci, 1)
+        return {
+            "cells": real_cells,
+            "pad_frac": round(max(pad_frac, 0.0), 6),
+            "bucket": f"c{padded_cells}xl{padded_loci}",
+        }
 
     def _emit_fit_events(self, step_name: str, fit: FitResult, wall: float,
                          num_cells: int, prior_iters: int = 0) -> None:
@@ -1710,9 +1744,14 @@ class PertInference:
                 if "cn_map" in qc_stats else None
             try:
                 faults_mod.point("qc/ppc")
+                ppc_t0 = time.perf_counter()
                 ppc_dev, ppc_z = jax.device_get(ppc_discrepancy(
                     spec, params, fixed, batch, key,
                     num_replicates=cfg.qc_ppc_replicates, maps=maps))
+                self.meter.book_exec(
+                    kind="ppc", seconds=time.perf_counter() - ppc_t0,
+                    ctx={"step": step_name,
+                         **self._meter_attrs(step_name, batch)})
                 ppc_dev = np.asarray(ppc_dev)[:n]
                 ppc_z = np.asarray(ppc_z)[:n]
             except Exception as exc:
@@ -1858,6 +1897,12 @@ class PertInference:
             # telemetry-disabled runs get no run_end (and so no final
             # snapshot event) — the textfile export must still land
             self.metrics.write_textfile()
+            if self._manifest is not None:
+                # durable cost record: the fleet index and pert_meter
+                # read device-seconds/goodput from the manifest when a
+                # run has no telemetry stream
+                self._manifest.doc["meter"] = self.meter.summary()
+                self._manifest.save()
         except Exception as exc:
             # terminal heartbeat on ERROR only: a BaseException
             # (SimulatedPreemption, KeyboardInterrupt, SIGKILL-adjacent
@@ -2020,9 +2065,30 @@ def package_step_output(
     timer = timer or profiling.PhaseTimer()
     want_entropy = qc_collect is not None
     with timer.phase(f"{phase_prefix}/decode"):
+        from scdna_replication_tools_tpu.obs import meter as _meter
+        from scdna_replication_tools_tpu.obs import runlog as _runlog
+
+        decode_t0 = time.perf_counter()
         decoded, ent_planes, want_entropy = _decode_with_degradation(
             spec, params, fixed, batch, data, hmm_self_prob,
             want_entropy, phase_prefix)
+        ledger = _meter.ledger_of(_runlog.current())
+        if ledger is not None:
+            # the decode/PPC slabs run at the fit's padded shape too —
+            # book their device time with the same bucket attribution
+            # (no iteration work units: goodput counts fit progress)
+            padded = (int(batch.reads.shape[0]),
+                      int(batch.reads.shape[1]))
+            real = (min(int(data.num_cells), padded[0]),
+                    min(int(data.num_loci), padded[1]))
+            ledger.book_exec(
+                kind="decode",
+                seconds=time.perf_counter() - decode_t0,
+                ctx={"step": f"{phase_prefix}/decode",
+                     "bucket": f"c{padded[0]}xl{padded[1]}",
+                     "pad_frac": round(max(
+                         1.0 - (real[0] * real[1])
+                         / max(padded[0] * padded[1], 1), 0.0), 6)})
         if qc_collect is not None and not want_entropy:
             # the degradation ladder dropped the optional QC surfaces;
             # tell the caller so it skips the QC table instead of

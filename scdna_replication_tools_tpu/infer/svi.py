@@ -36,6 +36,7 @@ import optax
 from scdna_replication_tools_tpu.infer import aotcache as _aotcache
 from scdna_replication_tools_tpu.obs import doctor as _doctor
 from scdna_replication_tools_tpu.obs import heartbeat as _heartbeat
+from scdna_replication_tools_tpu.obs import meter as _meter
 from scdna_replication_tools_tpu.obs import runlog as _runlog
 from scdna_replication_tools_tpu.ops import adam_kernel as _adam_kernel
 from scdna_replication_tools_tpu.utils import faults as _faults
@@ -419,6 +420,12 @@ class ChunkCall:
     args: tuple
     static_kwargs: dict
     solo: Callable
+    # cost-attribution handle: (CostLedger, ctx snapshot) captured on
+    # the lane's own thread at dispatch time, so the slab LEADER can
+    # book each lane's share of the dispatch into the right request's
+    # ledger with the lane's own step/bucket/pad_frac attribution.
+    # None = unmetered (no ledger on the lane's RunLog).
+    meter: Optional[tuple] = None
 
     def signature(self):
         try:
@@ -610,6 +617,8 @@ def _resolve_program(target, tag: str, loss_fn, dynamic_args,
         if cached is not None:
             timings["program_cache"] = "hit"
             compiled, stats = cached
+            if stats.get("flops"):
+                timings["flops"] = stats["flops"]
             _runlog.current().emit("compile", key_hash=_key_hash(key),
                                    label=type(loss_fn).__name__, tag=tag,
                                    cache="hit",
@@ -633,6 +642,11 @@ def _resolve_program(target, tag: str, loss_fn, dynamic_args,
                 compiled, stats, deser = loaded
                 timings["program_cache"] = "disk_hit"
                 timings["deserialize"] = deser
+                if stats.get("flops"):
+                    timings["flops"] = stats["flops"]
+                ledger = _meter.ledger_of(_runlog.current())
+                if ledger is not None:
+                    ledger.book_compile(seconds=deser, deserialize=True)
                 _runlog.current().emit(
                     "compile", key_hash=_key_hash(key),
                     label=type(loss_fn).__name__, tag=tag,
@@ -666,6 +680,11 @@ def _resolve_program(target, tag: str, loss_fn, dynamic_args,
         timings["compile"] = t2 - t1
         timings["program_cache"] = "miss"
         stats = _runlog.compiled_program_stats(compiled)
+        if stats.get("flops"):
+            timings["flops"] = stats["flops"]
+        ledger = _meter.ledger_of(_runlog.current())
+        if ledger is not None:
+            ledger.book_compile(seconds=t2 - t0)
         extra = {"aot_disk": "miss"} if store is not None else {}
         _runlog.current().emit("compile", key_hash=_key_hash(key),
                                label=type(loss_fn).__name__, tag=tag,
@@ -903,6 +922,11 @@ def fit_map(loss_fn: Callable, params0: dict, loss_args: tuple = (),
     if diag_every:
         diagnostics = _decode_diag(np.asarray(diag), n, i0_host, diag_every)
     timings["fit"] = time.perf_counter() - t0
+    ledger = _meter.ledger_of(_runlog.current())
+    if ledger is not None:
+        ledger.book_chunk(entry_it=i0_host, end_it=n,
+                          wall_seconds=timings["fit"],
+                          flops=float(timings.get("flops") or 0.0))
     health = _diagnose(losses_host, bool(converged), bool(is_nan),
                        diagnostics, doctor_thresholds)
     return FitResult(
@@ -1036,8 +1060,11 @@ def _fit_map_controlled(loss_fn: Callable, params0: dict, loss_args: tuple,
         return _run_fit_chunk(loss_fn, *args, **static_kwargs)
 
     # captured ONCE per fit: the dispatcher seam is thread-local and the
-    # chunk loop must not change engines mid-fit
+    # chunk loop must not change engines mid-fit.  Same for the cost
+    # ledger (it rides the thread-local RunLog).
     dispatcher = get_chunk_dispatcher()
+    ledger = _meter.ledger_of(_runlog.current())
+    chunk_flops = float(timings.get("flops") or 0.0)
 
     def run_chunk(params, opt_state, losses, diag, i_host, stop_host,
                   lr_val):
@@ -1045,10 +1072,23 @@ def _fit_map_controlled(loss_fn: Callable, params0: dict, loss_args: tuple,
                 as_i32(stop_host), min_iter_arr, rel_tol_arr,
                 as_f32(lr_val), loss_args)
         if dispatcher is not None:
+            meter = (ledger, ledger.ctx_snapshot()) \
+                if ledger is not None else None
             return dispatcher.dispatch(ChunkCall(
                 loss_fn=loss_fn, args=args, static_kwargs=static_kwargs,
-                solo=run_solo))
+                solo=run_solo, meter=meter))
         return run_solo(args)
+
+    # solo mode books its own chunks from inside the loop; in slab mode
+    # the coordinator books instead (the lane's wall includes rendezvous
+    # wait — only the leader sees the true dispatch wall and each
+    # lane's 1/W share of it)
+    book_chunk = None
+    if dispatcher is None and ledger is not None:
+        def book_chunk(entry_it, end_it, wall_seconds):
+            ledger.book_chunk(entry_it=int(entry_it), end_it=int(end_it),
+                              wall_seconds=float(wall_seconds),
+                              flops=chunk_flops)
 
     params, opt_state = params0, opt_state0
     i_host = i0_host
@@ -1110,7 +1150,8 @@ def _fit_map_controlled(loss_fn: Callable, params0: dict, loss_args: tuple,
             best_params=best_params, best_it=best_it, reseeds=reseeds,
             extra_granted=extra_granted, nan_retries=nan_retries,
             prev_verdict=prev_verdict,
-            stagnation_anchor=stagnation_anchor, snap=snap)
+            stagnation_anchor=stagnation_anchor, snap=snap,
+            book_chunk=book_chunk)
     except BaseException:
         _emergency_save(checkpoint_cb, snap)
         raise
@@ -1221,7 +1262,7 @@ def _chunk_loop(*, run_chunk, params, opt_state, losses, diag, i_host,
                 decisions, best_loss, best_params,
                 best_it, reseeds, extra_granted, nan_retries,
                 prev_verdict, stagnation_anchor, snap: dict,
-                moment_dtype: str = "float32"):
+                moment_dtype: str = "float32", book_chunk=None):
     """The host-side chunk loop of :func:`_fit_map_controlled`.
 
     ``snap`` is the caller-owned live-state snapshot: refreshed with
@@ -1256,6 +1297,11 @@ def _chunk_loop(*, run_chunk, params, opt_state, losses, diag, i_host,
             budget=int(budget), wall_seconds=chunk_t1 - chunk_t0,
             iters=int(i_now) - int(entry_it), action=str(action),
             verdict=verdict)
+        if book_chunk is not None:
+            # solo-mode cost booking rides the same once-per-outcome
+            # site as the heartbeat; a NaN rewind passes i_now < the
+            # step's high-water, which the ledger books as retry_refit
+            book_chunk(entry_it, i_now, chunk_t1 - chunk_t0)
         if tracer is None:
             return
         attrs = dict(chunk=chunks_done, iter_start=int(entry_it),

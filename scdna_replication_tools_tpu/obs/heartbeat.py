@@ -105,6 +105,7 @@ HEARTBEAT_FIELDS = frozenset({
     "run_name", "config_digest", "interval_seconds", "state", "phase",
     "step", "chunk", "iteration", "budget", "ms_per_iter_ewma",
     "eta_seconds", "trail", "last_span", "metrics", "faults", "error",
+    "goodput", "waste_frac",
 })
 
 #: aggregate fields (``aggregate_health`` output) the alert grammar may
@@ -188,6 +189,10 @@ class RunHeartbeat:
             "state": "running", "phase": None, "step": None,
             "chunk": None, "iteration": None, "budget": None,
             "ms_per_iter_ewma": None, "eta_seconds": None,
+            # live efficiency (obs/meter.py books them on every cost
+            # record): effective cell-iters per billed device-second
+            # and the billed fraction lost to named waste
+            "goodput": None, "waste_frac": None,
             "error": None,
         }
         self._trail: collections.deque = collections.deque(

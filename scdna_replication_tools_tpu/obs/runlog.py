@@ -40,7 +40,16 @@ from scdna_replication_tools_tpu.obs import metrics as _metrics
 from scdna_replication_tools_tpu.utils import profiling
 from scdna_replication_tools_tpu.utils.profiling import logger
 
-SCHEMA_VERSION = 8  # v8: causal span tracing (obs/spans.py) — the
+SCHEMA_VERSION = 9  # v9: the cost/goodput plane (obs/meter.py) — a
+# `meter` section on run_end (attributed device-seconds, effective
+# work, named waste decomposition; conservation: billed = effective +
+# sum(waste)), an optional `tenant` field on request_start/request_end
+# (multi-tenant serve attribution), and the compile event's disk-hit
+# arm regularized in the schema (`cache: disk_hit` +
+# `deserialize_seconds` + `aot_disk`, emitted since the PR-18 AOT
+# store but previously missing from runlog_schema.json — pre-v9
+# validators reject disk-hit-bearing streams);
+# v8: causal span tracing (obs/spans.py) — the
 # `span_end` event (one per closed span: trace_id/span_id/parent_id,
 # wall start + duration, typed attrs, process_index) plus the optional
 # `span` envelope on every other event and `trace_id` on run_start.
@@ -269,6 +278,13 @@ class RunLog:
         # logs (bench runs, tests) — a stale process-global registry
         # must never inject snapshot events into an unrelated stream
         self.metrics_registry = None
+        # the cost ledger riding this log (obs/meter.CostLedger, set by
+        # the runner/worker that owns the run): booking sites resolve
+        # it via meter.ledger_of(runlog.current()) — the same
+        # thread-local seam the compile events use — and close_run
+        # lands its summary as run_end's `meter` section.  None = the
+        # run is unmetered (bare logs, tests)
+        self.meter_ledger = None
         # the span tracer riding this log (obs/spans.attach_tracer):
         # None — the default — keeps the stream byte-for-byte free of
         # span material (no envelope, no span_end, no trace_id), which
@@ -405,6 +421,13 @@ class RunLog:
                                 "message": str(error)[:2000]}
         if phases:
             payload["phases"] = dict(phases)
+        if self.meter_ledger is not None:
+            try:
+                payload["meter"] = self.meter_ledger.summary()
+            except Exception:  # pertlint: disable=PL011 — a torn
+                # ledger must not cost the run_end record itself; the
+                # missing meter section is the visible symptom
+                pass
         self.emit("run_end", **payload)
         self._open = False
         if self._fh is not None:

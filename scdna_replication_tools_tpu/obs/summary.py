@@ -103,6 +103,17 @@ def derived_metrics(summary: dict) -> dict:
             out["pert_aot_disk_hits_total"] = comp["disk_hits"]
     if comp.get("peak_bytes_max") is not None:
         out["pert_peak_hbm_bytes"] = comp["peak_bytes_max"]
+    # the cost plane (schema v9): run_end's meter section makes the
+    # autopilot objective — device-seconds and goodput — a queryable
+    # per-run metric even when the registry snapshot predates the
+    # gauges (derived:runlog, like the wall-clock rows above)
+    meter = summary.get("meter") or {}
+    if meter.get("billed_device_seconds") is not None:
+        out["pert_device_seconds_total"] = round(
+            float(meter["billed_device_seconds"]), 4)
+    if meter.get("goodput_cell_iters_per_device_second") is not None:
+        out["pert_goodput_cell_iters_per_device_second"] = round(
+            float(meter["goodput_cell_iters_per_device_second"]), 3)
     return out
 
 
@@ -248,10 +259,17 @@ def summarize_events(events: List[dict]) -> dict:
             # like a hit, they paid no XLA invocation
             "disk_hits": disk_hits,
             # over cacheable resolutions only: 'uncacheable' events
-            # (unhashable loss closures) are neither hits nor misses and
-            # would understate the rate; disk hits count as hits (no
-            # XLA ran)
+            # (unhashable loss closures) are neither hits nor misses
+            # and would understate the rates.  Two distinct arms —
+            # hit_rate counts true IN-PROCESS hits (free), no_xla_rate
+            # adds disk hits (no XLA ran, but each paid its
+            # deserialize wall — restart cost the meter books as
+            # `compile_deserialize`, which a single rate used to hide)
             "hit_rate": (round(
+                cache_hits
+                / (cache_hits + disk_hits + cache_misses), 4)
+                if cache_hits + disk_hits + cache_misses else None),
+            "no_xla_rate": (round(
                 (cache_hits + disk_hits)
                 / (cache_hits + disk_hits + cache_misses), 4)
                 if cache_hits + disk_hits + cache_misses else None),
@@ -285,6 +303,7 @@ def summarize_events(events: List[dict]) -> dict:
         },
         "requests": [{
             "request_id": ev.get("request_id"),
+            "tenant": ev.get("tenant"),
             "status": ev.get("status"),
             "wall_seconds": ev.get("wall_seconds"),
             "queue_wait_seconds":
@@ -293,6 +312,10 @@ def summarize_events(events: List[dict]) -> dict:
             "compile_cache": ev.get("compile_cache"),
             "error_class": ev.get("error_class"),
         } for ev in _of(events, "request_end")],
+        # the cost/goodput plane (schema v9, obs/meter.py): run_end's
+        # attributed device-seconds + waste decomposition; None on
+        # pre-v9 / unmetered logs
+        "meter": end.get("meter") if end else None,
         # causal spans (schema v8, tracing-on runs only): rollup by
         # span name + the trace ids present; empty otherwise
         "spans": {

@@ -162,6 +162,14 @@ def main(argv=None) -> int:
                           help="per-request scRT option (whitelist: "
                                "serve/worker.py REQUEST_OPTION_KEYS); "
                                "repeatable")
+    p_submit.add_argument("--tenant", default=None,
+                          help="advisory tenant/cost-center label for "
+                               "the request: device-time attribution in "
+                               "the worker's status.json "
+                               "(processed.by_tenant) and the "
+                               "pert_meter attribution rollup.  The "
+                               "worker sanitizes it ([A-Za-z0-9._-], "
+                               "max 64 chars) before trusting it")
 
     p_status = sub.add_parser(
         "status", help="show one request's state (or the whole queue)")
@@ -211,7 +219,8 @@ def main(argv=None) -> int:
                            options=_parse_option(args.option),
                            request_id=args.request_id,
                            priority=args.priority,
-                           deadline_unix=deadline)
+                           deadline_unix=deadline,
+                           tenant=args.tenant)
         _emit(rid)
         return 0
 

@@ -96,6 +96,13 @@ class RequestTicket:
     # the input TSVs.  Advisory only — admission re-probes the real
     # frames; a hint-less ticket is still claimable
     shape: Optional[dict] = None
+    # multi-tenant attribution (schema v9): an OPTIONAL caller-supplied
+    # tenant label the meter and the worker's by-tenant rollup key cost
+    # on.  Advisory identity, not authentication — the worker SANITIZES
+    # it (charset/length) before trusting it anywhere (a spool writer
+    # can forge any ticket field; a forged tenant must not be able to
+    # break status.json or smuggle bytes into event streams)
+    tenant: Optional[str] = None
     # terminal fields, filled by the worker's finish()
     status: Optional[str] = None          # ok / failed / refused
     error: Optional[str] = None
@@ -149,7 +156,8 @@ class SpoolQueue:
                request_id: Optional[str] = None,
                priority: str = "normal",
                deadline_unix: Optional[float] = None,
-               shape: Optional[dict] = None) -> str:
+               shape: Optional[dict] = None,
+               tenant: Optional[str] = None) -> str:
         """Queue a request referencing existing input TSVs; returns the
         request id.  Submission is atomic: the worker either sees the
         whole ticket in ``pending/`` or nothing."""
@@ -174,7 +182,8 @@ class SpoolQueue:
             priority=priority,
             deadline_unix=(round(float(deadline_unix), 3)
                            if deadline_unix is not None else None),
-            shape=dict(shape) if shape else None)
+            shape=dict(shape) if shape else None,
+            tenant=str(tenant) if tenant else None)
         atomic_write_bytes(self._ticket_path("pending", request_id),
                            ticket.to_json())
         return request_id
@@ -182,7 +191,8 @@ class SpoolQueue:
     def submit_frames(self, df_s, df_g1, options: Optional[dict] = None,
                       request_id: Optional[str] = None,
                       priority: str = "normal",
-                      deadline_unix: Optional[float] = None) -> str:
+                      deadline_unix: Optional[float] = None,
+                      tenant: Optional[str] = None) -> str:
         """Queue a request from in-memory long-form frames: the frames
         land as TSVs under ``data/<id>/`` BEFORE the ticket appears in
         ``pending/`` (the ticket's atomic rename is the commit point,
@@ -211,7 +221,8 @@ class SpoolQueue:
             shape = None  # unprobeable frames: admission decides
         return self.submit(s_path, g1_path, options=options,
                            request_id=request_id, priority=priority,
-                           deadline_unix=deadline_unix, shape=shape)
+                           deadline_unix=deadline_unix, shape=shape,
+                           tenant=tenant)
 
     # -- worker side ------------------------------------------------------
 

@@ -39,6 +39,14 @@ from typing import Dict, List, Optional
 
 from scdna_replication_tools_tpu.infer import svi as _svi
 
+try:  # the coordinator times true device wall (dispatch is async);
+    # jax is already a dependency of _svi, but keep the import soft so
+    # pure-bookkeeping consumers (SlabState in tools) load without it
+    import jax as _jax
+except Exception:  # pertlint: disable=PL011 — no backend: wall
+    # degrades to enqueue time, the meter still conserves
+    _jax = None
+
 
 class _Block:
     __slots__ = ("request_id", "started_unix", "started_perf",
@@ -142,13 +150,18 @@ _UNSET = object()
 
 
 class _PendingChunk:
-    __slots__ = ("call", "result", "error", "done")
+    __slots__ = ("call", "result", "error", "done", "book")
 
     def __init__(self, call):
         self.call = call
         self.result = _UNSET
         self.error: Optional[BaseException] = None
         self.done = False
+        # cost-attribution thunk stamped by the leader, run by the
+        # OWNING lane thread after ``done`` — it device-syncs on the
+        # result, so running it on the leader would serialize the
+        # dispatch pipeline and starve the packing rendezvous
+        self.book = None
 
 
 class SlabFitCoordinator:
@@ -190,6 +203,11 @@ class SlabFitCoordinator:
         self.dispatches = 0        # leader executions
         self.packed_dispatches = 0  # slab-program dispatches (>= 2 lanes)
         self.packed_lanes = 0      # lanes advanced by slab dispatches
+        # the WORKER-session cost ledger (obs/meter.py), attached by the
+        # serve worker: parked-lane device time — a rung dispatched
+        # wider than its live lane count — is the slab's own waste, not
+        # any request's, so it books here as ``retired_lane``
+        self.meter_ledger = None
 
     # -- driver bracket ---------------------------------------------------
 
@@ -245,6 +263,13 @@ class SlabFitCoordinator:
             raise entry.error
         if entry.result is _UNSET:
             raise RuntimeError("slab coordinator dropped a chunk dispatch")
+        if entry.book is not None:
+            try:  # lane-side cost booking: the device sync this does
+                # is one the lane's driver was about to pay anyway
+                entry.book()
+            except Exception:  # pertlint: disable=PL011 — metering
+                # must never fail a dispatch whose result is committed
+                pass
         return entry.result
 
     # -- leader path (no coordinator lock held) ---------------------------
@@ -269,12 +294,21 @@ class SlabFitCoordinator:
             group = groups[key]
             if len(group) >= 2:
                 try:
+                    slab_timings: dict = {}
+                    t0 = time.perf_counter()
                     outs = _svi.dispatch_chunk_slab(
-                        [e.call for e in group], self.width)
+                        [e.call for e in group], self.width,
+                        timings=slab_timings)
                     for e, out in zip(group, outs):
                         e.result = out
                     self.packed_dispatches += 1
                     self.packed_lanes += len(group)
+                    # metering is deferred to the LEAD lane's thread:
+                    # the leader must stay async (no device sync here)
+                    # or arriving peers always see the barrier met and
+                    # dispatch solo — the packing rendezvous starves
+                    group[0].book = self._slab_book_thunk(
+                        group, outs, t0, slab_timings)
                     continue
                 except BaseException:  # pertlint: disable=PL011 — not
                     # a swallow: the slab failed as a UNIT (compile
@@ -285,9 +319,67 @@ class SlabFitCoordinator:
                     pass
             for e in group:
                 try:
+                    t0 = time.perf_counter()
                     e.result = e.call.solo(e.call.args)
+                    if e.call.meter is not None:
+                        e.book = self._solo_book_thunk(e, t0)
                 except BaseException as exc:  # pertlint: disable=PL011
                     # — not a swallow: ``dispatch`` re-raises
                     # ``entry.error`` on the owning block thread, whose
                     # request pipeline reports it (fault isolation)
                     e.error = exc
+
+    def _slab_book_thunk(self, group, outs, t0: float,
+                         slab_timings: dict):
+        def _book():
+            if _jax is not None:
+                _jax.block_until_ready(outs)
+            self._book_slab(group, outs,
+                            time.perf_counter() - t0, slab_timings)
+        return _book
+
+    def _solo_book_thunk(self, e, t0: float):
+        def _book():
+            if _jax is not None:
+                _jax.block_until_ready(e.result)
+            ledger, ctx = e.call.meter
+            ledger.book_chunk(
+                entry_it=int(e.call.args[4]),
+                end_it=int(e.result[0]),
+                wall_seconds=time.perf_counter() - t0,
+                ctx=ctx, kind="chunk")
+        return _book
+
+    def _book_slab(self, group, outs, wall: float,
+                   slab_timings: dict) -> None:
+        """Attribute one packed dispatch's device time: the W-wide rung
+        bills wall x devices split W ways — each live lane books its
+        1/W share (padding + retry_refit decomposed by ITS ledger with
+        ITS booking context), the (W - n) parked vacancies book as
+        ``retired_lane`` waste on the worker-session ledger.
+        Best-effort by contract: metering must never fail a dispatch
+        whose results are already committed."""
+        try:
+            W = 2
+            while W < len(group):
+                W *= 2
+            flops = float(slab_timings.get("flops") or 0.0)
+            for e, out in zip(group, outs):
+                if e.call.meter is None:
+                    continue
+                ledger, ctx = e.call.meter
+                ledger.book_chunk(
+                    entry_it=int(e.call.args[4]), end_it=int(out[0]),
+                    wall_seconds=wall, device_share=1.0 / W,
+                    flops=flops / W, ctx=ctx, kind="slab_lane")
+            parked = W - len(group)
+            if parked > 0 and self.meter_ledger is not None:
+                # attribute the vacancy to the slab's rung so the
+                # by_bucket rollup shows WHERE refill lagged
+                lead_ctx = (group[0].call.meter or (None, {}))[1]
+                self.meter_ledger.book_retired(
+                    seconds=wall, device_share=parked / W,
+                    ctx={"bucket": lead_ctx.get("bucket")})
+        except Exception:  # pertlint: disable=PL011 — a torn ledger
+            # (request retired mid-book) costs the record, not the fit
+            return

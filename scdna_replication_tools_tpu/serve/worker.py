@@ -86,6 +86,7 @@ from typing import Optional
 import pandas as pd
 
 from scdna_replication_tools_tpu.obs import heartbeat as heartbeat_mod
+from scdna_replication_tools_tpu.obs import meter as meter_mod
 from scdna_replication_tools_tpu.obs import metrics as metrics_mod
 from scdna_replication_tools_tpu.obs import spans as spans_mod
 from scdna_replication_tools_tpu.obs.runlog import RunLog
@@ -147,6 +148,9 @@ class RequestOutcome:
     # batched mode: the request completed while >= 1 slab peer kept
     # fitting (its decode/stream-back overlapped their fit time)
     retired_early: bool = False
+    # sanitized tenant label (cost attribution rollup); never the raw
+    # ticket string — see ServeWorker._sanitize_tenant
+    tenant: Optional[str] = None
 
 
 class ServeWorker:
@@ -284,6 +288,24 @@ class ServeWorker:
         # request's own log feeds its own — no cross-feeding even
         # though both are live in one process
         self.worker_log.metrics_registry = self.registry
+        # the WORKER-SESSION cost ledger (obs/meter.py): books the
+        # device time no single request owns — claim-gap idle
+        # (queue_idle) and parked slab lanes (retired_lane via the
+        # coordinator) — and lands its summary in run()'s stats +
+        # status.json + the worker log's run_end.  Each request's own
+        # billed/waste lives in ITS run's ledger (the runner attaches
+        # one per request pipeline)
+        self.meter = meter_mod.CostLedger(
+            scope={"worker": "pert_serve", "spool": str(queue.root)})
+        self.meter.metrics_registry = self.registry
+        self.worker_log.meter_ledger = self.meter
+        if self.slab_coordinator is not None:
+            self.slab_coordinator.meter_ledger = self.meter
+        # per-tenant processed rollup (status.json processed.by_tenant)
+        self._by_tenant: dict = {}
+        # claim-gap bookkeeping: perf stamp of the last request
+        # retirement (or worker start) -> next claim books queue_idle
+        self._idle_since = time.perf_counter()
         # the slab gauges (manifest-pinned): configured width is
         # static; occupancy moves on every admit/retire
         self.registry.gauge("pert_serve_batch_width").set(self.max_batch)
@@ -364,12 +386,17 @@ class ServeWorker:
         self.registry.write_textfile()
         return {
             "processed": self._processed,
+            "by_tenant": dict(self._by_tenant),
             "by_status": dict(self._status_counts),
             "drained": self._draining,
             "pending_left": self.queue.depth(),
             "worker_log": self.worker_log.path,
             "status_path": str(self.queue.status_path),
             "outcomes": [dataclasses.asdict(o) for o in self.outcomes],
+            # session cost plane: billed/effective/waste decomposition
+            # for everything this worker dispatched (worker-scope only;
+            # per-request fit costs live in each request's run.jsonl)
+            "meter": self.meter.summary(),
         }
 
     def _finish_outcome(self, outcome: RequestOutcome) -> None:
@@ -378,6 +405,9 @@ class ServeWorker:
             self._status_counts[outcome.status] = \
                 self._status_counts.get(outcome.status, 0) + 1
             self._processed += 1
+            if outcome.tenant:
+                self._by_tenant[outcome.tenant] = \
+                    self._by_tenant.get(outcome.tenant, 0) + 1
         self.registry.write_textfile()
         self._write_status()
 
@@ -633,8 +663,14 @@ class ServeWorker:
             "queue_depth": self.queue.depth(),
             "in_flight": inflight,
             "slab": slab,
-            "processed": self._processed,
+            # processed rollup: total plus the per-tenant attribution
+            # (sanitized labels only — see _sanitize_tenant)
+            "processed": {"total": self._processed,
+                          "by_tenant": dict(self._by_tenant)},
             "by_status": dict(self._status_counts),
+            # cost digest: the worker-session meter's headline numbers
+            # (full decomposition in the run() stats / worker log)
+            "meter": self.meter.brief(),
             # bucket-residency ledger: which compiled shape families
             # this worker is keeping warm, and how much traffic each
             # has served — the eviction/right-sizing signal
@@ -686,6 +722,23 @@ class ServeWorker:
                         if k in REQUEST_OPTION_KEYS})
         return options
 
+    _TENANT_BAD = re.compile(r"[^A-Za-z0-9._-]")
+
+    @staticmethod
+    def _sanitize_tenant(value) -> Optional[str]:
+        """Sanitize the ticket's advisory tenant label before it is
+        trusted anywhere (worker log events, ``status.json`` rollups,
+        meter attribution).  The spool is a filesystem drop-box: any
+        process that can write a ticket controls this string, so the
+        worker never echoes it raw — characters outside
+        ``[A-Za-z0-9._-]`` are squashed to ``_`` and the result is
+        truncated to 64 chars.  Empty/None (or a value that sanitizes
+        to nothing) attributes to no tenant at all."""
+        if value is None:
+            return None
+        cleaned = ServeWorker._TENANT_BAD.sub("_", str(value))[:64]
+        return cleaned or None
+
     def process_request(self, ticket: RequestTicket) -> RequestOutcome:
         rid = ticket.request_id
         results_dir = self.queue.results_dir(rid)
@@ -731,6 +784,13 @@ class ServeWorker:
                                    float(ticket.claimed_unix),
                                    request_id=rid)
         with self._state_lock:
+            if not self._inflight:
+                # claim-gap accounting: the device sat idle from the
+                # last retirement (or worker start) until this claim —
+                # billed to the worker session as queue_idle waste
+                idle = time.perf_counter() - self._idle_since
+                if idle > 0:
+                    self.meter.book_queue_idle(seconds=idle)
             self._inflight[rid] = {"request_id": rid,
                                    "started_unix": round(time.time(), 3)}
         self.slab.admit(rid)
@@ -763,6 +823,10 @@ class ServeWorker:
                 self._inflight.pop(rid, None)
                 self._request_tracers.pop(rid, None)
                 self._slab_facts.pop(rid, None)
+                if not self._inflight:
+                    # last in-flight request retired: the claim gap
+                    # (queue_idle) starts now
+                    self._idle_since = time.perf_counter()
 
     def _slab_exit(self, rid: str) -> dict:
         """Retire the block from the slab ledger — idempotent: the
@@ -793,6 +857,7 @@ class ServeWorker:
     def _process_claimed(self, ticket, rid, results_dir, t0, depth,
                          options, bucket, tracer, req_span,
                          queue_wait) -> RequestOutcome:
+        tenant = self._sanitize_tenant(getattr(ticket, "tenant", None))
         admission_cm = tracer.span("admission", request_id=rid) \
             if tracer is not None else contextlib.nullcontext()
         try:
@@ -815,7 +880,7 @@ class ServeWorker:
                 pad_frac=round(pad_frac, 6), queue_depth=depth,
                 queue_wait_seconds=(round(queue_wait, 6)
                                     if queue_wait is not None else None),
-                shape=shape)
+                tenant=tenant, shape=shape)
             # bucket-residency ledger (status.json): admitted traffic
             # per compiled shape family this worker keeps warm
             with self._state_lock:
@@ -831,17 +896,18 @@ class ServeWorker:
                 pad_frac=None, queue_depth=depth,
                 queue_wait_seconds=(round(queue_wait, 6)
                                     if queue_wait is not None else None),
-                detail="refused at admission")
+                tenant=tenant, detail="refused at admission")
             slab_attrs = self._slab_end_attrs(rid)
             self.worker_log.emit(
                 "request_end", request_id=rid, status="refused",
                 wall_seconds=round(wall, 4), error=str(exc)[:500],
-                **slab_attrs)
+                tenant=tenant, **slab_attrs)
             self.queue.finish(ticket, "refused", error=str(exc),
                               results_dir=results_dir)
             logger.warning("pert-serve: request %s refused: %s", rid,
                            exc)
             return self._record(rid, "refused", wall, error=str(exc),
+                                tenant=tenant,
                                 retired_early=bool(
                                     slab_attrs.get("retired_early",
                                                    False)))
@@ -856,19 +922,20 @@ class ServeWorker:
                 pad_frac=None, queue_depth=depth,
                 queue_wait_seconds=(round(queue_wait, 6)
                                     if queue_wait is not None else None),
-                detail="failed at admission")
+                tenant=tenant, detail="failed at admission")
             slab_attrs = self._slab_end_attrs(rid)
             self.worker_log.emit(
                 "request_end", request_id=rid, status="failed",
                 wall_seconds=round(wall, 4),
                 error=f"{type(exc).__name__}: {str(exc)[:400]}",
                 error_class="admission",
-                **slab_attrs)
+                tenant=tenant, **slab_attrs)
             self.queue.finish(ticket, "failed", error=str(exc),
                               results_dir=results_dir)
             logger.warning("pert-serve: request %s failed at admission "
                            "(%s)", rid, exc)
             return self._record(rid, "failed", wall, error=str(exc),
+                                tenant=tenant,
                                 retired_early=bool(
                                     slab_attrs.get("retired_early",
                                                    False)))
@@ -900,6 +967,7 @@ class ServeWorker:
                 error=f"{type(exc).__name__}: {str(exc)[:400]}",
                 error_class=kind, run_log=run_log_path,
                 results_dir=str(results_dir),
+                tenant=tenant,
                 detail=("request isolated: the per-request durable-run "
                         "artifacts (checkpoints, RunLog, manifest) "
                         "carry the post-mortem; the worker and queue "
@@ -917,6 +985,7 @@ class ServeWorker:
                                 error=f"{type(exc).__name__}: "
                                       f"{str(exc)[:400]}",
                                 run_log=run_log_path,
+                                tenant=tenant,
                                 retired_early=bool(
                                     slab_attrs.get("retired_early",
                                                    False)))
@@ -939,7 +1008,7 @@ class ServeWorker:
             "request_end", request_id=rid, status="ok",
             wall_seconds=round(wall, 4), bucket=bucket_info,
             run_log=run_log_path, results_dir=str(results_dir),
-            compile_cache=compile_cache, **slab_attrs)
+            compile_cache=compile_cache, tenant=tenant, **slab_attrs)
         self.queue.finish(ticket, "ok", results_dir=results_dir)
         logger.info(
             "pert-serve: request %s ok in %.1fs (bucket %s, compile "
@@ -950,6 +1019,7 @@ class ServeWorker:
         return self._record(rid, "ok", wall, bucket=bucket_info,
                             run_log=run_log_path,
                             compile_cache=compile_cache,
+                            tenant=tenant,
                             retired_early=bool(
                                 slab_attrs.get("retired_early", False)))
 
@@ -1021,9 +1091,10 @@ class ServeWorker:
     def _record(self, rid: str, status: str, wall: float,
                 bucket=None, error=None, run_log=None,
                 compile_cache=None,
-                retired_early: bool = False) -> RequestOutcome:
+                retired_early: bool = False,
+                tenant: Optional[str] = None) -> RequestOutcome:
         return RequestOutcome(
             request_id=rid, status=status,
             wall_seconds=round(wall, 4), bucket=bucket, error=error,
             run_log=run_log, compile_cache=compile_cache,
-            retired_early=retired_early)
+            retired_early=retired_early, tenant=tenant)

@@ -1,0 +1,446 @@
+"""The cost plane (obs/meter.py + tools/pert_meter.py): conservation,
+waste attribution, tenant accounting.
+
+The ledger's contract is a single invariant — every booked record,
+every rollup slot and every cross-ledger merge satisfies
+``billed == effective + sum(waste)`` — plus the attribution semantics
+riding on it: padding waste from the bucket contract's ``pad_frac``,
+``retry_refit`` from the per-step iteration high-water mark (a
+fault-ladder re-entry re-fits iterations the trajectory already had),
+``retired_lane`` from slab occupancy, ``queue_idle`` from serve claim
+gaps, and the per-tenant rollup keyed on the worker's SANITIZED tenant
+label (the spool is a filesystem drop-box; a forged ticket string is
+never echoed raw).
+"""
+
+import json
+import pathlib
+import sys
+
+import pytest
+
+from scdna_replication_tools_tpu.obs import heartbeat as heartbeat_mod
+from scdna_replication_tools_tpu.obs.meter import (
+    WASTE_CATEGORIES,
+    CostLedger,
+    conservation_gap,
+    ledger_of,
+)
+from scdna_replication_tools_tpu.obs.runlog import RunLog
+from scdna_replication_tools_tpu.obs.schema import validate_run
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]
+                       / "tools"))
+
+
+def _assert_conserves(meter_dict):
+    assert conservation_gap(meter_dict) < 1e-6, meter_dict
+
+
+# ---------------------------------------------------------------------------
+# the conservation invariant
+# ---------------------------------------------------------------------------
+
+
+def test_conservation_across_all_booking_kinds():
+    """Every typed booking entry point produces conserving records, and
+    the per-step / per-bucket / total rollups conserve too."""
+    led = CostLedger(scope={"run": "t"}, devices=1)
+    with led.context(step="step2", bucket="c32xl64", cells=24,
+                     pad_frac=0.25):
+        led.book_chunk(entry_it=0, end_it=100, wall_seconds=2.0)
+        # a rewound re-fit of iterations 50..100: retry_refit waste
+        led.book_chunk(entry_it=50, end_it=100, wall_seconds=0.5)
+        led.book_compile(seconds=1.5)
+        led.book_compile(seconds=0.3, deserialize=True)
+        led.book_exec(kind="decode", seconds=0.8)
+    with led.context(step="step3", bucket="c64xl64", cells=48):
+        led.book_chunk(entry_it=0, end_it=50, wall_seconds=1.0)
+    led.book_retired(seconds=2.0, device_share=0.25)
+    led.book_queue_idle(seconds=0.7)
+
+    summary = led.summary()
+    _assert_conserves(summary)
+    for slot in list(summary["by_step"].values()) \
+            + list(summary["by_bucket"].values()):
+        _assert_conserves(slot)
+    # every waste category the taxonomy names actually landed
+    assert set(summary["waste_seconds"]) == set(WASTE_CATEGORIES)
+    # and the waste names stay inside the closed taxonomy
+    assert all(k in WASTE_CATEGORIES for k in summary["waste_seconds"])
+    # billed = 2.0 + 0.5 + 1.5 + 0.3 + 0.8 + 1.0 + 0.5 + 0.7
+    assert summary["billed_device_seconds"] == pytest.approx(7.3)
+    # goodput counts fit progress only: 24 * 100 + 48 * 50
+    assert summary["cell_iters"] == pytest.approx(4800.0)
+
+
+def test_overbooked_waste_is_clamped_to_billed():
+    """Waste can never exceed billed (conservation by construction):
+    an overbooked record scales its categories proportionally."""
+    led = CostLedger(devices=1)
+    rec = led.book(kind="x", wall_seconds=1.0,
+                   waste={"compile": 3.0, "padding": 1.0})
+    assert rec["billed_device_seconds"] == pytest.approx(1.0)
+    assert sum(rec["waste"].values()) == pytest.approx(1.0)
+    # proportions preserved: 3:1
+    assert rec["waste"]["compile"] == pytest.approx(0.75)
+    assert rec["effective_device_seconds"] == pytest.approx(0.0)
+    _assert_conserves(led.totals())
+
+
+def test_device_count_multiplies_billed_time():
+    led = CostLedger(devices=4)
+    rec = led.book(kind="x", wall_seconds=2.0)
+    assert rec["billed_device_seconds"] == pytest.approx(8.0)
+    _assert_conserves(led.totals())
+
+
+# ---------------------------------------------------------------------------
+# retry_refit: the fault-ladder re-entry accounting
+# ---------------------------------------------------------------------------
+
+
+def test_retry_refit_on_rewound_iterations():
+    """A NaN rewind (or resume overlap) re-runs iterations below the
+    step's high-water mark: they bill, but as retry_refit waste, and
+    credit no fresh cell-iterations."""
+    led = CostLedger(devices=1)
+    with led.context(step="step2", cells=10):
+        first = led.book_chunk(entry_it=0, end_it=100, wall_seconds=1.0)
+        assert first["waste"] == {}
+        assert first["cell_iters"] == pytest.approx(1000.0)
+        # fault-ladder re-entry: rewound to iteration 40, re-fit to 100
+        redo = led.book_chunk(entry_it=40, end_it=100, wall_seconds=0.6)
+        assert redo["waste"]["retry_refit"] == pytest.approx(0.6)
+        assert redo["cell_iters"] == 0.0
+        # past the high-water mark again: fresh work, no refit waste
+        cont = led.book_chunk(entry_it=100, end_it=150,
+                              wall_seconds=0.5)
+        assert cont["waste"] == {}
+        assert cont["cell_iters"] == pytest.approx(500.0)
+    step = led.summary()["by_step"]["step2"]
+    _assert_conserves(step)
+    assert step["waste_seconds"]["retry_refit"] == pytest.approx(0.6)
+
+
+def test_retry_refit_composes_with_padding():
+    """Padding takes its pad_frac share first; retry_refit decomposes
+    the remaining (non-padding) time by the refitted iteration share —
+    the two categories never double-bill the same device-second."""
+    led = CostLedger(devices=1)
+    with led.context(step="s", cells=8, pad_frac=0.5):
+        led.book_chunk(entry_it=0, end_it=100, wall_seconds=1.0)
+        redo = led.book_chunk(entry_it=50, end_it=100, wall_seconds=0.5)
+    assert redo["waste"]["padding"] == pytest.approx(0.25)
+    assert redo["waste"]["retry_refit"] == pytest.approx(0.25)
+    assert redo["effective_device_seconds"] == pytest.approx(0.0)
+    _assert_conserves(led.totals())
+
+
+def test_iter_high_water_is_per_step():
+    led = CostLedger(devices=1)
+    with led.context(step="step2", cells=1):
+        led.book_chunk(entry_it=0, end_it=100, wall_seconds=1.0)
+    with led.context(step="step3", cells=1):
+        # a different step starts its own high-water: no refit waste
+        rec = led.book_chunk(entry_it=0, end_it=100, wall_seconds=1.0)
+    assert rec["waste"] == {}
+
+
+# ---------------------------------------------------------------------------
+# slab occupancy: retired-lane waste
+# ---------------------------------------------------------------------------
+
+
+def test_slab_booking_matches_pinned_occupancy():
+    """A W=4 rung carrying 3 live lanes: each lane bills wall/W into
+    its own ledger, the parked (W-n)/W books as retired_lane on the
+    worker ledger — total attributed time equals wall x devices, and
+    retired time equals (1 - occupancy) x wall exactly."""
+    from types import SimpleNamespace
+
+    from scdna_replication_tools_tpu.serve.slab import (
+        SlabFitCoordinator,
+    )
+
+    wall = 2.0
+    lanes = [CostLedger(scope={"request": f"r{i}"}, devices=1)
+             for i in range(3)]
+    worker_led = CostLedger(scope={"worker": "w"}, devices=1)
+    group = []
+    for i, led in enumerate(lanes):
+        ctx = {"step": "step2", "bucket": "c32xl64", "cells": 10,
+               "pad_frac": 0.0}
+        call = SimpleNamespace(meter=(led, ctx),
+                               args=(None, None, None, None, 0))
+        group.append(SimpleNamespace(call=call))
+    outs = [(40,), (40,), (40,)]
+    coord = SimpleNamespace(meter_ledger=worker_led)
+    SlabFitCoordinator._book_slab(coord, group, outs, wall,
+                                  {"flops": 400.0})
+
+    per_lane = [led.totals() for led in lanes]
+    for t in per_lane:
+        _assert_conserves(t)
+        assert t["billed_device_seconds"] == pytest.approx(wall / 4)
+        assert t["flops"] == pytest.approx(100.0)
+    retired = worker_led.totals()
+    _assert_conserves(retired)
+    occupancy = 3 / 4
+    assert retired["waste_seconds"]["retired_lane"] == pytest.approx(
+        (1 - occupancy) * wall)
+    total_attributed = sum(t["billed_device_seconds"]
+                           for t in per_lane) \
+        + retired["billed_device_seconds"]
+    assert total_attributed == pytest.approx(wall)
+    # the vacancy is attributed to the rung for the by_bucket rollup
+    assert "c32xl64" in worker_led.summary()["by_bucket"]
+
+
+# ---------------------------------------------------------------------------
+# live surfaces: heartbeat freshness, RunLog embedding
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_goodput_tracks_bookings(tmp_path):
+    """Every booking refreshes the live heartbeat's goodput/waste_frac
+    fields — the pert-watch plane shows cost efficiency mid-fit, not
+    only at run_end."""
+    hb = heartbeat_mod.RunHeartbeat(tmp_path / "health",
+                                    interval_seconds=0.05)
+    heartbeat_mod.install(hb)
+    try:
+        led = CostLedger(devices=1)
+        with led.context(step="s", cells=10):
+            led.book_chunk(entry_it=0, end_it=100, wall_seconds=1.0)
+        assert hb._fields["goodput"] == pytest.approx(1000.0)
+        assert hb._fields["waste_frac"] == pytest.approx(0.0)
+        led.book_compile(seconds=1.0)
+        assert hb._fields["goodput"] == pytest.approx(500.0)
+        assert hb._fields["waste_frac"] == pytest.approx(0.5)
+    finally:
+        heartbeat_mod.install(None)
+
+
+def test_runlog_carries_meter_on_run_end(tmp_path):
+    """The ledger rides the RunLog seam: ``run_log.meter_ledger`` is
+    discoverable via ledger_of(), and close_run embeds the summary in
+    run_end (schema v9) — which still validates."""
+    path = tmp_path / "run.jsonl"
+    log = RunLog(path)
+    led = CostLedger(scope={"run": "t"}, devices=1)
+    log.meter_ledger = led
+    assert ledger_of(log) is led
+    with log.session(config={}, run_name="meter_test"):
+        with led.context(step="s", cells=5, pad_frac=0.2):
+            led.book_chunk(entry_it=0, end_it=10, wall_seconds=1.0)
+    events = [json.loads(line) for line in path.read_text().splitlines()]
+    end = next(e for e in events if e["event"] == "run_end")
+    meter = end["meter"]
+    _assert_conserves(meter)
+    assert meter["waste_seconds"]["padding"] == pytest.approx(0.2)
+    assert meter["by_step"]["s"]["records"] == 1
+    validate_run(path)
+
+
+# ---------------------------------------------------------------------------
+# tenant accounting: sanitization + the per-tenant rollup
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_sanitization_pins():
+    from scdna_replication_tools_tpu.serve.worker import ServeWorker
+
+    clean = ServeWorker._sanitize_tenant
+    assert clean(None) is None
+    assert clean("") is None
+    assert clean("team-a.prod_1") == "team-a.prod_1"
+    # a forged path-traversal label is squashed, never echoed raw
+    assert clean("../../etc/passwd") == ".._.._etc_passwd"
+    assert clean("evil tenant\n$(rm -rf)") == "evil_tenant___rm_-rf_"
+    # overlong labels truncate to 64
+    assert clean("x" * 200) == "x" * 64
+    assert clean("!!!") == "___"
+
+
+def test_worker_rolls_up_sanitized_tenants(tmp_path):
+    """End-to-end over a real (admission-failing, so fast) worker
+    session: the ticket's tenant rides submit -> spool -> worker, the
+    worker sanitizes it before trusting it anywhere — request events,
+    status.json processed.by_tenant, run() stats — and the worker log
+    still validates against the schema."""
+    from scdna_replication_tools_tpu.serve import (
+        ServeWorker,
+        SpoolQueue,
+    )
+
+    q = SpoolQueue(tmp_path / "spool")
+    q.submit("/nonexistent/s.tsv", "/nonexistent/g1.tsv",
+             request_id="r_forged", tenant="../../etc/passwd")
+    q.submit("/nonexistent/s.tsv", "/nonexistent/g1.tsv",
+             request_id="r_plain", tenant="team-a")
+    q.submit("/nonexistent/s.tsv", "/nonexistent/g1.tsv",
+             request_id="r_anon")
+    worker = ServeWorker(q, max_requests=3, exit_when_idle=True)
+    stats = worker.run()
+
+    assert stats["processed"] == 3
+    assert stats["by_tenant"] == {".._.._etc_passwd": 1, "team-a": 1}
+    events = [json.loads(line) for line
+              in open(stats["worker_log"]).read().splitlines()]
+    by_rid = {e["request_id"]: e for e in events
+              if e.get("event") == "request_end"}
+    assert by_rid["r_forged"]["tenant"] == ".._.._etc_passwd"
+    assert by_rid["r_plain"]["tenant"] == "team-a"
+    assert by_rid["r_anon"]["tenant"] is None
+    # the raw forged string appears NOWHERE in the worker log
+    assert "../../etc/passwd" not in pathlib.Path(
+        stats["worker_log"]).read_text()
+    status = json.loads(q.status_path.read_text())
+    assert status["processed"]["total"] == 3
+    assert status["processed"]["by_tenant"] == stats["by_tenant"]
+    # the worker-session cost digest rides the same surface (the three
+    # claim gaps are queue_idle waste, so billed is non-zero)
+    assert status["meter"]["billed_device_seconds"] >= 0.0
+    _assert_conserves(stats["meter"])
+    validate_run(stats["worker_log"])
+
+
+# ---------------------------------------------------------------------------
+# the CLI: report / attribution / ab
+# ---------------------------------------------------------------------------
+
+
+def _mk_meter(step="step2", bucket="c32xl64", cells=10, pad_frac=0.25,
+              iters=100, wall=2.0, compile_s=0.5):
+    led = CostLedger(scope={"request": "x"}, devices=1)
+    with led.context(step=step, bucket=bucket, cells=cells,
+                     pad_frac=pad_frac):
+        led.book_compile(seconds=compile_s)
+        led.book_chunk(entry_it=0, end_it=iters, wall_seconds=wall)
+    return led.summary()
+
+
+@pytest.fixture()
+def fake_spool(tmp_path):
+    """A synthetic spool: one worker log (two finished requests with
+    tenants + a worker-session run_end meter) and each request's own
+    run log carrying its meter — the exact join surface
+    ``pert_meter attribution`` walks."""
+    spool = tmp_path / "spool"
+    (spool / "results" / "r1").mkdir(parents=True)
+    (spool / "results" / "r2").mkdir(parents=True)
+    run_logs = {}
+    for rid, tenant in (("r1", "team-a"), ("r2", "team-b")):
+        meter = _mk_meter()
+        log = spool / "results" / rid / "run.jsonl"
+        log.write_text(json.dumps(
+            {"event": "run_end", "status": "ok", "meter": meter}) + "\n")
+        run_logs[rid] = str(log)
+    worker_led = CostLedger(scope={"worker": "w"}, devices=1)
+    worker_led.book_queue_idle(seconds=1.0)
+    events = [
+        {"event": "request_end", "request_id": "r1", "status": "ok",
+         "tenant": "team-a", "bucket": {"name": "c32xl64"},
+         "wall_seconds": 3.0, "run_log": run_logs["r1"]},
+        {"event": "request_end", "request_id": "r2", "status": "ok",
+         "tenant": "team-b", "bucket": {"name": "c32xl64"},
+         "wall_seconds": 3.1, "run_log": run_logs["r2"]},
+        {"event": "run_end", "status": "ok",
+         "meter": worker_led.summary()},
+    ]
+    (spool / "worker_1.jsonl").write_text(
+        "".join(json.dumps(e) + "\n" for e in events))
+    return spool
+
+
+def test_cli_report_on_run_log_and_spool(fake_spool, capsys):
+    from tools import pert_meter
+
+    run_log = fake_spool / "results" / "r1" / "run.jsonl"
+    rc = pert_meter.main(["report", str(run_log), "--json", "--check"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["conservation_ok"] is True
+    # billed = 0.5 compile + 2.0 chunk
+    assert doc["meter"]["billed_device_seconds"] == pytest.approx(2.5)
+    assert doc["meter"]["waste_seconds"]["padding"] == pytest.approx(0.5)
+
+    rc = pert_meter.main(["report", str(fake_spool), "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    # worker idle + both requests
+    assert doc["meter"]["billed_device_seconds"] == pytest.approx(6.0)
+    assert {r["request_id"] for r in doc["requests"]} == {"r1", "r2"}
+
+    # the markdown waterfall renders too (no --json)
+    rc = pert_meter.main(["report", str(fake_spool)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "waste: `padding`" in out and "**effective**" in out
+
+
+def test_cli_attribution_rolls_up_tenants(fake_spool, capsys):
+    from tools import pert_meter
+
+    rc = pert_meter.main(["attribution", str(fake_spool), "--json",
+                          "--check"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["conservation_ok"] is True
+    assert set(doc["by_tenant"]) == {"team-a", "team-b"}
+    assert doc["by_tenant"]["team-a"]["requests"] == 1
+    assert doc["by_tenant"]["team-a"]["billed_device_seconds"] \
+        == pytest.approx(2.5)
+    assert doc["by_bucket"]["c32xl64"]["requests"] == 2
+    # rollup = worker (1.0 idle) + 2 x 2.5
+    assert doc["meter"]["billed_device_seconds"] == pytest.approx(6.0)
+
+
+def test_cli_attribution_check_fails_on_violation(tmp_path, capsys):
+    """--check is a real gate: a ledger that does not conserve exits 1."""
+    from tools import pert_meter
+
+    spool = tmp_path / "spool"
+    spool.mkdir()
+    broken = {"billed_device_seconds": 10.0,
+              "effective_device_seconds": 1.0,
+              "waste_seconds": {"padding": 1.0}, "cell_iters": 0.0,
+              "records": 1}
+    (spool / "worker_1.jsonl").write_text(
+        json.dumps({"event": "run_end", "meter": broken}) + "\n")
+    assert pert_meter.main(["attribution", str(spool), "--json",
+                            "--check"]) == 1
+    capsys.readouterr()
+
+
+def test_cli_ab_compares_arms(fake_spool, tmp_path, capsys):
+    from tools import pert_meter
+
+    other = tmp_path / "other.jsonl"
+    other.write_text(json.dumps(
+        {"event": "run_end",
+         "meter": _mk_meter(pad_frac=0.0, wall=1.0, compile_s=0.0)})
+        + "\n")
+    rc = pert_meter.main(["ab", str(fake_spool), str(other), "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["a"]["meter"]["billed_device_seconds"] \
+        == pytest.approx(6.0)
+    assert doc["b"]["meter"]["billed_device_seconds"] \
+        == pytest.approx(1.0)
+    assert doc["deltas"]["billed_device_seconds_ratio"] \
+        == pytest.approx(1.0 / 6.0, rel=1e-3)
+    # arm B wastes nothing; A carries padding + compile + idle
+    assert doc["deltas"]["waste_frac_delta"] < 0.0
+
+
+def test_merge_meters_conserves():
+    from tools.pert_meter import merge_meters
+
+    merged = merge_meters([_mk_meter(), _mk_meter(pad_frac=0.5),
+                           None, {}])
+    _assert_conserves(merged)
+    assert merged["records"] == 4  # 2 x (compile + chunk)
+    assert merged["billed_device_seconds"] == pytest.approx(5.0)

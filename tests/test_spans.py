@@ -448,7 +448,7 @@ def test_worker_status_json_atomic_and_heartbeat_fresh(tmp_path):
     assert final["state"] == "stopped"
     assert final["queue_depth"] == 0
     assert final["in_flight"] is None
-    assert final["processed"] == 0
+    assert final["processed"]["total"] == 0
     assert "buckets_served" in final and "recent" in final
 
 
@@ -459,7 +459,7 @@ def test_worker_status_records_outcomes_and_queue(tmp_path):
     worker.run()
     doc = json.loads(q.status_path.read_text())
     assert doc["by_status"] == {"failed": 1}
-    assert doc["processed"] == 1
+    assert doc["processed"]["total"] == 1
     assert [o["request_id"] for o in doc["recent"]] == ["r_fail"]
     assert doc["state"] == "stopped"
 

@@ -209,6 +209,11 @@ def run(args):
         "run_log": scrt.run_log_path,
         "metrics_textfile": args.metrics_textfile,
         "fleet_metrics": fleet_metrics,
+        # the cost plane (schema v9 run_end.meter): attributed
+        # device-seconds, the waste decomposition, and goodput in
+        # cell-iterations per device-second — render with
+        # `python -m tools.pert_meter report <run_log>`
+        "meter": (run_summary or {}).get("meter"),
         "peak_hbm_bytes": compile_info.get("peak_bytes_max"),
         "compile_cache_hits": compile_info.get("cache_hits"),
         "compile_cache_misses": compile_info.get("cache_misses"),

@@ -102,6 +102,11 @@ def run_record(path) -> Optional[dict]:
         mtime = path.stat().st_mtime
     except OSError:
         mtime = None
+    # the cost plane (schema v9 run_end.meter): attributed device time
+    # and goodput, promoted to top-level record fields so `query
+    # --format json` answers "what did this run cost" without a
+    # re-parse (ISSUE acceptance: the fleet surface of the meter)
+    meter = summary.get("meter") or {}
     return {
         "path": str(path),
         "file": path.name,
@@ -119,6 +124,9 @@ def run_record(path) -> Optional[dict]:
         "num_devices": summary.get("num_devices"),
         "status": summary.get("status"),
         "wall_seconds": summary.get("wall_seconds"),
+        "device_seconds": meter.get("billed_device_seconds"),
+        "goodput": meter.get("goodput_cell_iters_per_device_second"),
+        "waste_frac": meter.get("waste_frac"),
         "workload": {
             "num_cells": max(cells) if cells else None,
             "steps": sorted({str(f.get("step")) for f in fits

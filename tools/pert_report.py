@@ -459,6 +459,16 @@ def _run_health_section(path, health_dir=None) -> list:
     return render_health_markdown(aggregate, verdicts)
 
 
+def _meter_section(summary: dict) -> list:
+    """The cost/goodput waterfall (schema v9 ``run_end.meter``):
+    billed device-seconds -> named waste -> effective, plus goodput
+    and the conservation check — the same renderer ``pert_meter
+    report`` uses.  Placeholder on pre-v9 / unmetered logs."""
+    from tools.pert_meter import render_waterfall
+
+    return render_waterfall(summary.get("meter"))
+
+
 def render_report(path, health_dir=None) -> str:
     summary = summarize_run(path)
     if summary is None:
@@ -466,6 +476,7 @@ def render_report(path, health_dir=None) -> str:
     lines = _header(summary)
     lines += _run_health_section(path, health_dir)
     lines += _phase_waterfall(summary["phases"])
+    lines += _meter_section(summary)
     lines += _spans_section(summary)
     lines += _fit_table(summary["fits"])
     lines += _model_health_section(summary.get("fit_health", []),

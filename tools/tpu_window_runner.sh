@@ -206,6 +206,17 @@ for attempt in $(seq 1 200); do
             # in the same trend/regress surface as the CPU rounds
             python -m tools.pert_fleet index --roots .pert_runs artifacts \
                 --out artifacts/FLEET_INDEX_r06_tpu.json >> "$LOG" 2>&1 || true
+            # cost plane: one meter waterfall per battery run log —
+            # device-seconds, waste taxonomy, conservation verdict —
+            # concatenated next to the fleet index so a TPU window's
+            # goodput is inspectable without replaying anything
+            : > artifacts/METER_r20_tpu_battery.md
+            find .pert_runs -name '*.jsonl' 2>/dev/null | sort | \
+            while read -r rl; do
+                echo "## ${rl}" >> artifacts/METER_r20_tpu_battery.md
+                timeout 60 python -m tools.pert_meter report "$rl" \
+                    >> artifacts/METER_r20_tpu_battery.md 2>>"$LOG" || true
+            done
             exit 0
         fi
     fi
